@@ -1,0 +1,37 @@
+"""ed25519-consensus-tpu: Ed25519 signing and ZIP215 consensus verification,
+TPU-native.
+
+A from-scratch rebuild of the capabilities of the Rust crate
+`ed25519-consensus` (reference layout in SURVEY.md): exact host arithmetic
+for every consensus-critical accept/reject decision, plus a JAX/Pallas TPU
+backend for the batch-verification multiscalar multiplication, sharded over
+device meshes for large batches.
+
+Public surface mirrors reference src/lib.rs:6-16."""
+
+from . import batch
+from .error import (
+    Error,
+    InvalidSignature,
+    InvalidSliceLength,
+    MalformedPublicKey,
+    MalformedSecretKey,
+)
+from .signature import Signature
+from .signing_key import SigningKey
+from .verification_key import VerificationKey, VerificationKeyBytes
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Error",
+    "MalformedSecretKey",
+    "MalformedPublicKey",
+    "InvalidSignature",
+    "InvalidSliceLength",
+    "Signature",
+    "SigningKey",
+    "VerificationKey",
+    "VerificationKeyBytes",
+    "batch",
+]

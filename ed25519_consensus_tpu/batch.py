@@ -1,0 +1,167 @@
+"""Adaptive batch verification with ZIP215 semantics and a pluggable MSM
+backend (reference src/batch.rs).
+
+The verification equation for n signatures from m distinct keys is the random
+linear combination
+
+    [-Σ z_i·s_i]B + Σ [z_i]R_i + Σ [z_i·k_i]A_i = 0       (then ·[8])
+
+with 128-bit random blinders z_i.  Entries are grouped by verification key so
+all z_i·k_i terms per key coalesce into one A-coefficient: the MSM has
+n + m + 1 terms instead of 2n + 1 (reference src/batch.rs:149-203) — ~2×
+faster when all signatures share one key.
+
+Backend split (BASELINE.json north star): ALL rejection decisions — point
+decompression, `s < ℓ`, and the final cofactor/identity check — happen on the
+host with exact integer math, so a malformed batch never reaches the device
+and the verdict can never depend on device behavior.  Only the bulk MSM is
+dispatched, to either the exact host Straus (`backend="host"`) or the
+TPU/JAX limb kernel (`backend="device"`, see ops/msm.py)."""
+
+import hashlib
+import secrets
+
+from .error import InvalidSignature
+from .ops import edwards, scalar
+from .signature import Signature
+from .verification_key import VerificationKey, VerificationKeyBytes
+
+
+def gen_u128(rng=None) -> int:
+    """A random 128-bit blinding integer (reference src/batch.rs:64-68).
+    `rng` may be a `random.Random` for deterministic tests."""
+    if rng is None:
+        return secrets.randbits(128)
+    return rng.getrandbits(128)
+
+
+def _as_item(value) -> "Item":
+    if isinstance(value, Item):
+        return value
+    if isinstance(value, tuple) and len(value) == 3:
+        return Item.new(*value)
+    raise TypeError("expected Item or (vk_bytes, sig, msg) tuple")
+
+
+class Item:
+    """A queued batch entry, decoupled from the message lifetime: the
+    challenge k = H(R‖A‖msg) is computed eagerly at queue time (reference
+    src/batch.rs:70-94)."""
+
+    __slots__ = ("vk_bytes", "sig", "k")
+
+    def __init__(self, vk_bytes: VerificationKeyBytes, sig: Signature, k: int):
+        self.vk_bytes = vk_bytes
+        self.sig = sig
+        self.k = k
+
+    @classmethod
+    def new(cls, vk_bytes, sig: Signature, msg: bytes) -> "Item":
+        if not isinstance(vk_bytes, VerificationKeyBytes):
+            vk_bytes = VerificationKeyBytes(vk_bytes)
+        h = hashlib.sha512()
+        h.update(sig.R_bytes)
+        h.update(vk_bytes.to_bytes())
+        h.update(msg)
+        return cls(vk_bytes, sig, scalar.from_hash(h))
+
+    def clone(self) -> "Item":
+        return Item(self.vk_bytes, self.sig, self.k)
+
+    def verify_single(self) -> None:
+        """Non-batched fallback verification of this item (reference
+        src/batch.rs:96-108); used to pinpoint failures after a batch
+        rejection.  Raises on failure."""
+        vk = VerificationKey.from_bytes(self.vk_bytes)
+        vk.verify_prehashed(self.sig, self.k)
+
+    def __repr__(self):
+        return (
+            f"Item(vk_bytes={self.vk_bytes!r}, sig={self.sig!r}, "
+            f"k={self.k:#x})"
+        )
+
+
+class Verifier:
+    """A batch verification context (reference src/batch.rs:110-218)."""
+
+    def __init__(self):
+        # vk_bytes -> list of (k, sig); insertion-ordered grouping is the
+        # coalescing mechanism (reference HashMap, src/batch.rs:112-118).
+        self.signatures = {}
+        self.batch_size = 0
+
+    def queue(self, item) -> None:
+        """Queue an `Item` or `(vk_bytes, sig, msg)` tuple (reference
+        src/batch.rs:127-137)."""
+        item = _as_item(item)
+        self.signatures.setdefault(item.vk_bytes, []).append(
+            (item.k, item.sig)
+        )
+        self.batch_size += 1
+
+    # -- staging (host, exact) --------------------------------------------
+
+    def _stage(self, rng):
+        """Host staging: decompress all points, enforce `s < ℓ`, sample
+        blinders, coalesce per-key A coefficients.  Returns the flat MSM
+        term list (scalars, points).  Raises InvalidSignature on ANY
+        malformed input — before any device dispatch (all-or-nothing
+        semantics, reference src/batch.rs:139-147, 182-203)."""
+        B_coeff = 0
+        A_coeffs, As = [], []
+        R_coeffs, Rs = [], []
+        for vk_bytes, sigs in self.signatures.items():
+            A = edwards.decompress(vk_bytes.to_bytes())
+            if A is None:
+                raise InvalidSignature()
+            A_coeff = 0
+            for k, sig in sigs:
+                R = edwards.decompress(sig.R_bytes)
+                if R is None:
+                    raise InvalidSignature()
+                s = scalar.from_canonical_bytes(sig.s_bytes)
+                if s is None:
+                    raise InvalidSignature()
+                z = gen_u128(rng)
+                B_coeff = scalar.sub(B_coeff, scalar.mul(z, s))
+                Rs.append(R)
+                R_coeffs.append(scalar.reduce(z))
+                A_coeff = scalar.add(A_coeff, scalar.mul(z, k))
+            As.append(A)
+            A_coeffs.append(A_coeff)
+        scalars = [B_coeff] + A_coeffs + R_coeffs
+        points = [edwards.BASEPOINT] + As + Rs
+        return scalars, points
+
+    # -- verification ------------------------------------------------------
+
+    def verify(self, rng=None, backend: str = "host") -> None:
+        """Verify all queued signatures; raises InvalidSignature unless ALL
+        are valid (reference src/batch.rs:149-217).
+
+        `backend` selects where the bulk MSM runs: "host" (exact Straus) or
+        "device" (TPU/JAX limb kernel; verdict-equivalent by construction —
+        the exact-arithmetic parity is pinned by tests/test_device_parity.py).
+        """
+        scalars, points = self._stage(rng)
+        if backend == "host":
+            check = edwards.multiscalar_mul(scalars, points)
+        elif backend == "device":
+            try:
+                from .ops import msm
+            except ImportError as e:
+                raise NotImplementedError(
+                    "device MSM backend unavailable: " + str(e)
+                ) from e
+            check = msm.device_msm(scalars, points)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        # Final cofactored identity check: host-exact, always.
+        if not check.mul_by_cofactor().is_identity():
+            raise InvalidSignature()
+
+    def verify_tpu(self, rng=None) -> None:
+        """Convenience entry point for the device backend (the analog of the
+        north-star `Verifier::verify_tpu()`)."""
+        self.verify(rng=rng, backend="device")

@@ -1,0 +1,2 @@
+"""Arithmetic cores: exact host math (field, scalar, edwards) and the
+JAX/TPU limb kernels (limbs, jnp_field, jnp_edwards, msm, pallas_msm)."""

@@ -1,0 +1,2 @@
+"""Support utilities: conformance fixtures, the legacy differential oracle,
+and torsion helpers (SURVEY.md §2.1 components 13-14, §2.2 N11)."""

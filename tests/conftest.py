@@ -1,0 +1,12 @@
+"""Test configuration: force JAX onto the CPU backend with 8 virtual devices
+BEFORE any jax import, so the multi-chip sharding path is exercised without
+TPU hardware (SURVEY.md §4 build mapping)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

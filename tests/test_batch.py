@@ -1,0 +1,93 @@
+"""Batch verification behavior (reference tests/batch.rs): happy path,
+all-or-nothing failure, per-item fallback pinpointing, and coalescing."""
+
+import random
+
+import pytest
+
+from ed25519_consensus_tpu import InvalidSignature, SigningKey, batch
+
+rng = random.Random(0xBA7C4)
+
+
+def test_batch_verify():
+    bv = batch.Verifier()
+    for _ in range(32):
+        sk = SigningKey.new(rng)
+        msg = b"BatchVerifyTest"
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    bv.verify(rng=rng)  # raises on failure
+
+
+def test_batch_verify_with_one_bad_sig():
+    bad_index = 10
+    bv = batch.Verifier()
+    items = []
+    for i in range(32):
+        sk = SigningKey.new(rng)
+        msg = b"BatchVerifyTest"
+        sig = sk.sign(msg) if i != bad_index else sk.sign(b"badmsg")
+        item = batch.Item.new(sk.verification_key_bytes(), sig, msg)
+        items.append(item.clone())
+        bv.queue(item)
+    with pytest.raises(InvalidSignature):
+        bv.verify(rng=rng)
+    # Fallback: per-item verification pinpoints exactly the bad index.
+    for i, item in enumerate(items):
+        if i != bad_index:
+            item.verify_single()
+        else:
+            with pytest.raises(InvalidSignature):
+                item.verify_single()
+
+
+def test_batch_coalescing_same_key():
+    # All signatures from ONE key: m=1, MSM size n+2; must still verify.
+    sk = SigningKey.new(rng)
+    bv = batch.Verifier()
+    for i in range(16):
+        msg = b"msg-%d" % i
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    assert len(bv.signatures) == 1  # coalesced into a single key group
+    assert bv.batch_size == 16
+    bv.verify(rng=rng)
+
+
+def test_batch_rejects_malformed_s():
+    # Non-canonical s (>= ℓ) must be rejected at staging, before any MSM.
+    from ed25519_consensus_tpu import Signature
+    from ed25519_consensus_tpu.ops.scalar import L
+
+    sk = SigningKey.new(rng)
+    msg = b"x"
+    good = sk.sign(msg)
+    bad = Signature(good.R_bytes, (L).to_bytes(32, "little"))
+    bv = batch.Verifier()
+    bv.queue((sk.verification_key_bytes(), bad, msg))
+    with pytest.raises(InvalidSignature):
+        bv.verify(rng=rng)
+
+
+def test_batch_rejects_malformed_key():
+    # A non-point vk encoding fails the batch with InvalidSignature
+    # (NOT MalformedPublicKey — matching reference src/batch.rs:183-185).
+    from ed25519_consensus_tpu import VerificationKeyBytes
+    from ed25519_consensus_tpu.ops import edwards
+
+    bad_vk = None
+    for y in range(2, 64):
+        enc = y.to_bytes(32, "little")
+        if edwards.decompress(enc) is None:
+            bad_vk = enc
+            break
+    assert bad_vk is not None
+    sk = SigningKey.new(rng)
+    sig = sk.sign(b"x")
+    bv = batch.Verifier()
+    bv.queue((VerificationKeyBytes(bad_vk), sig, b"x"))
+    with pytest.raises(InvalidSignature):
+        bv.verify(rng=rng)
+
+
+def test_empty_batch_verifies():
+    batch.Verifier().verify(rng=rng)

@@ -1,0 +1,121 @@
+"""The consensus-critical 196-case small-order conformance matrix
+(reference tests/small_order.rs).
+
+For every pair (A, R) drawn from the 14 interesting encodings — the 8
+canonical 8-torsion encodings plus the 6 low-order non-canonical encodings —
+with s = 0, the expected verdict is computed analytically under BOTH rule
+sets, then checked against this library (ZIP215) and the legacy differential
+oracle (pre-ZIP215, libsodium-1.0.15-compatible)."""
+
+import hashlib
+import random
+
+import pytest
+
+from ed25519_consensus_tpu import (
+    InvalidSignature,
+    MalformedPublicKey,
+    Signature,
+    VerificationKey,
+    VerificationKeyBytes,
+    batch,
+)
+from ed25519_consensus_tpu.ops import edwards, scalar
+from ed25519_consensus_tpu.utils import fixtures
+from ed25519_consensus_tpu.utils.legacy import legacy_verify
+
+MSG = b"Zcash"
+
+
+def _encodings():
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    assert len(encs) == 14
+    return encs
+
+
+def _cases():
+    """The 196 test cases with analytically-derived verdicts (reference
+    tests/small_order.rs:12-77)."""
+    cases = []
+    s_bytes = b"\x00" * 32
+    for A_bytes in _encodings():
+        A = edwards.decompress(A_bytes)
+        assert A is not None
+        for R_bytes in _encodings():
+            R = edwards.decompress(R_bytes)
+            assert R is not None
+            sig_bytes = R_bytes + s_bytes
+            # ZIP215: [8][s]B = [8]R + [8][k]A; with s=0 and torsion A, R
+            # both sides vanish — always valid.
+            valid_zip215 = True
+            # Legacy: [s]B = R + [k]A must hold with recomputed canonical R,
+            # A must not be all-zero, R must not be blacklisted.
+            h = hashlib.sha512()
+            h.update(sig_bytes[0:32])
+            h.update(A_bytes)
+            h.update(MSG)
+            k = scalar.from_hash(h)
+            check = R.add(A.scalar_mul(k))
+            non_canonical_R = R.compress() != R_bytes
+            valid_legacy = not (
+                A_bytes == b"\x00" * 32
+                or R.compress() in fixtures.EXCLUDED_POINT_ENCODINGS
+                or not check.is_identity()
+                or non_canonical_R
+            )
+            cases.append((A_bytes, sig_bytes, valid_legacy, valid_zip215))
+    assert len(cases) == 196
+    return cases
+
+
+CASES = _cases()
+
+
+def _zip215_verdict(vk_bytes: bytes, sig_bytes: bytes) -> bool:
+    try:
+        vk = VerificationKey.from_bytes(vk_bytes)
+        vk.verify(Signature.from_bytes(sig_bytes), MSG)
+        return True
+    except (InvalidSignature, MalformedPublicKey):
+        return False
+
+
+def test_conformance():
+    """Our ZIP215 verdicts AND the legacy oracle's verdicts both match the
+    analytic model on all 196 cases (reference tests/small_order.rs:80-86)."""
+    for A_bytes, sig_bytes, valid_legacy, valid_zip215 in CASES:
+        assert _zip215_verdict(A_bytes, sig_bytes) == valid_zip215, (
+            f"zip215 mismatch: vk={A_bytes.hex()} sig={sig_bytes.hex()}"
+        )
+        assert legacy_verify(A_bytes, sig_bytes, MSG) == valid_legacy, (
+            f"legacy mismatch: vk={A_bytes.hex()} sig={sig_bytes.hex()}"
+        )
+
+
+def test_rules_actually_diverge():
+    """Sanity: the two rule sets must disagree somewhere in the matrix."""
+    assert any(
+        valid_legacy != valid_zip215 for _, _, valid_legacy, valid_zip215 in CASES
+    )
+
+
+def test_individual_matches_batch_verification():
+    """The core ZIP215 guarantee: single-verify verdict == batch-of-one
+    verdict, for every case (reference tests/small_order.rs:89-104)."""
+    rng = random.Random(0x215)
+    for A_bytes, sig_bytes, _, _ in CASES:
+        sig = Signature.from_bytes(sig_bytes)
+        vkb = VerificationKeyBytes(A_bytes)
+        individual = _zip215_verdict(A_bytes, sig_bytes)
+        bv = batch.Verifier()
+        bv.queue((vkb, sig, MSG))
+        try:
+            bv.verify(rng=rng)
+            batched = True
+        except InvalidSignature:
+            batched = False
+        assert individual == batched, (
+            f"batch/individual divergence: vk={A_bytes.hex()} "
+            f"sig={sig_bytes.hex()}"
+        )

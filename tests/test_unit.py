@@ -1,0 +1,76 @@
+"""Round-trip and smoke tests (reference tests/unit_tests.rs)."""
+
+import random
+
+import pytest
+
+from ed25519_consensus_tpu import (
+    InvalidSliceLength,
+    Signature,
+    SigningKey,
+    VerificationKey,
+    VerificationKeyBytes,
+)
+
+rng = random.Random(0x0A1D)
+
+
+def test_parsing_roundtrips():
+    sk = SigningKey.new(rng)
+    pk = sk.verification_key()
+    pkb = sk.verification_key_bytes()
+    sig = sk.sign(b"test")
+
+    sk_array = sk.to_bytes()
+    pk_array = pk.to_bytes()
+    pkb_array = pkb.to_bytes()
+    sig_array = sig.to_bytes()
+    assert len(sk_array) == 64 and len(sig_array) == 64
+    assert len(pk_array) == 32 and len(pkb_array) == 32
+
+    # from_bytes round trips (covers both the Try-From-slice and the
+    # "bincode" raw-bytes deserialization of the reference).
+    assert SigningKey.from_bytes(sk_array).to_bytes() == sk_array
+    assert VerificationKey.from_bytes(pk_array).to_bytes() == pk_array
+    assert VerificationKeyBytes(pkb_array).to_bytes() == pkb_array
+    assert Signature.from_bytes(sig_array).to_bytes() == sig_array
+
+
+def test_bad_lengths_rejected():
+    for n in (0, 31, 33, 63, 65):
+        with pytest.raises(InvalidSliceLength):
+            VerificationKeyBytes(b"\x00" * n)
+        with pytest.raises(InvalidSliceLength):
+            Signature.from_bytes(b"\x00" * n)
+    with pytest.raises(InvalidSliceLength):
+        SigningKey.from_bytes(b"\x00" * 33)
+
+
+def test_sign_and_verify():
+    sk = SigningKey.new(rng)
+    pk = sk.verification_key()
+    msg = b"ed25519-consensus test message"
+    sig = sk.sign(msg)
+    pk.verify(sig, msg)  # raises on failure
+
+
+def test_verify_rejects_wrong_message():
+    from ed25519_consensus_tpu import InvalidSignature
+
+    sk = SigningKey.new(rng)
+    sig = sk.sign(b"message one")
+    with pytest.raises(InvalidSignature):
+        sk.verification_key().verify(sig, b"message two")
+
+
+def test_signing_key_repr_redacts_secrets():
+    sk = SigningKey.new(rng)
+    r = repr(sk)
+    assert sk.prefix.hex() not in r
+    assert format(sk.s, "x") not in r
+
+
+def test_zeroize():
+    sk = SigningKey.new(rng)
+    sk.zeroize()
+    assert sk.s == 0 and sk.prefix == b"\x00" * 32
