@@ -1,0 +1,84 @@
+"""Edwards25519 group ops on int32 limb tensors (JAX/XLA, TPU-first).
+
+Points are (4, NLIMBS, ...) int32 tensors — extended coordinates
+(X : Y : Z : T) with each coordinate a normalized limb vector.  The addition
+law is the same COMPLETE unified formula as the exact host implementation
+(ops/edwards.py, add-2008-hwcd-3 with a = -1, k = 2d), so it is valid for
+every input including identity padding, doublings, and 8-torsion points —
+there is no data-dependent branching anywhere, which is exactly what XLA
+wants (SURVEY.md §2.3).
+
+Exact-integer semantics: every limb op is exact int32 arithmetic, so device
+points equal host points as group elements (projectively); parity is pinned
+by tests/test_device_parity.py.
+"""
+
+import jax.numpy as jnp
+
+from . import jnp_field as F
+from .field import D2, P
+from .limbs import NLIMBS, int_to_limbs
+
+# Normalized limb constant 2d, kept as numpy so it enters each trace as a
+# fresh constant (a cached jax array would leak tracers across jit scopes).
+_D2_NP = int_to_limbs(D2 % P)
+
+
+def _d2(shape_like):
+    # (NLIMBS,) -> (NLIMBS, 1, 1, ...) to broadcast with (NLIMBS, ...)
+    extra = shape_like.ndim - 1
+    return jnp.asarray(_D2_NP).reshape((NLIMBS,) + (1,) * extra)
+
+
+def point_add(p, q):
+    """Complete unified addition on (4, NLIMBS, ...) tensors.
+
+    A=(Y1-X1)(Y2-X2), B=(Y1+X1)(Y2+X2), C=2d·T1·T2, D=2·Z1·Z2,
+    E=B-A, F=D-C, G=D+C, H=B+A; X3=EF, Y3=GH, Z3=FG, T3=EH."""
+    X1, Y1, Z1, T1 = p[0], p[1], p[2], p[3]
+    X2, Y2, Z2, T2 = q[0], q[1], q[2], q[3]
+    A = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
+    B = F.mul(F.add(Y1, X1), F.add(Y2, X2))
+    C = F.mul(F.mul(T1, _d2(T1)), T2)
+    Dv = F.mul_small(F.mul(Z1, Z2), 2)
+    E = F.sub(B, A)
+    Fv = F.sub(Dv, C)
+    G = F.add(Dv, C)
+    H = F.add(B, A)
+    return jnp.stack(
+        [F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H)]
+    )
+
+
+def point_double(p):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4 squarings instead of the
+    8 general multiplications of `point_add` — the MSM scan is half
+    doublings, so this is the hot op."""
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    A = F.mul(X1, X1)
+    B = F.mul(Y1, Y1)
+    C = F.mul_small(F.mul(Z1, Z1), 2)
+    # E = (X1+Y1)^2 - A - B;  G = B - A;  F = G - C;  H = -(A + B)
+    S = F.add(X1, Y1)
+    E = F.sub(F.sub(F.mul(S, S), A), B)
+    G = F.sub(B, A)
+    Fv = F.sub(G, C)
+    H = F.sub(F.sub(G, B), B)  # -(A+B) == (B - A) - B - B
+    return jnp.stack(
+        [F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H)]
+    )
+
+
+def point_select(mask, p, q):
+    """where(mask, p, q) over (4, NLIMBS, ...) points; mask is
+    batch-shaped."""
+    return jnp.where(mask[None, None, ...], p, q)
+
+
+def identity_like(p):
+    """(0 : 1 : 1 : 0) broadcast to the shape of p."""
+    ident = jnp.zeros_like(p)
+    one = jnp.ones_like(p[0, 0])
+    ident = ident.at[1, 0].set(one)
+    ident = ident.at[2, 0].set(one)
+    return ident

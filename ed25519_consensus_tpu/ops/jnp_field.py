@@ -1,0 +1,118 @@
+"""GF(2^255 - 19) arithmetic on int32 limb tensors (JAX/XLA, TPU-first).
+
+Elements are (NLIMBS, ...) int32 tensors of 13-bit limbs (see limbs.py); all
+ops are elementwise/vector ops on the trailing batch axes — on TPU they run
+full-width on the VPU lanes, and everything fuses under jit.
+
+Overflow discipline (int32, signed):
+
+* **normalized**: every limb in [0, 2^13).
+* mul: schoolbook on normalized inputs — each partial product
+  < 2^26, each of the 39 columns sums ≤ 20 partial products < 20·2^26 <
+  2^30.33 < 2^31 - 1.  ✓
+* carry chains use arithmetic shifts, so intermediate NEGATIVE limbs
+  (from sub) are handled: t >> 13 floors, t & 0x1fff extracts a nonneg
+  residue, and t == (t >> 13)·2^13 + (t & 0x1fff) holds for all int32 t.
+* carries escaping limb 19 have weight 2^260 ≡ 608 (mod p) and are folded
+  back into limb 0 (2^260 - 608 = 32p, so the fold subtracts a multiple of
+  p — valid for carries of either sign).
+* `_carry` runs THREE passes after mul/sub (two after add): pass 1 bounds
+  all limbs to [0, 2^13) with a fold of at most ±2^18·608 < 2^28 into
+  limb 0; pass 2 re-normalizes with a fold of at most ±608; pass 3 clears
+  the final ripple.  Exactness (not just plausibility) is pinned by
+  tests/test_device_parity.py against the exact host field on random and
+  adversarial inputs.
+
+Everything here computes values CONGRUENT mod p, not canonical residues;
+canonicalization happens on the host after unpacking (limbs.py), which is
+where all consensus decisions live.
+"""
+
+import jax.numpy as jnp
+
+from .limbs import FOLD, LIMB_BITS, LIMB_MASK, NLIMBS
+
+WIDE = 2 * NLIMBS  # columns of a schoolbook product (indices 0..38, +carry)
+
+
+def _carry_pass(limbs):
+    """One serial carry pass over a list of per-limb tensors; returns
+    normalized-limb list plus the carry escaping the top limb."""
+    out = []
+    c = None
+    for k in range(len(limbs)):
+        t = limbs[k] if c is None else limbs[k] + c
+        out.append(t & LIMB_MASK)
+        c = t >> LIMB_BITS
+    return out, c
+
+
+def _fold_carry(limbs, c):
+    """Fold a carry of weight 2^260 back into limb 0 (≡ ·608 mod p)."""
+    limbs = list(limbs)
+    limbs[0] = limbs[0] + c * FOLD
+    return limbs
+
+
+def carry(x, passes: int):
+    """Normalize a (NLIMBS, ...) limb tensor: `passes` carry passes, folding
+    top-limb escapes mod p each time.  See module docstring for why 2 or 3
+    passes suffice per op."""
+    limbs = [x[i] for i in range(NLIMBS)]
+    for _ in range(passes):
+        limbs, c = _carry_pass(limbs)
+        limbs = _fold_carry(limbs, c)
+    return jnp.stack(limbs)
+
+
+def add(a, b):
+    """a + b (mod p), normalized.  Inputs must be normalized."""
+    return carry(a + b, passes=2)
+
+
+def sub(a, b):
+    """a - b (mod p), normalized.  Signed intermediates are fine (arithmetic
+    shifts); three passes absorb the worst-case negative ripple."""
+    return carry(a - b, passes=3)
+
+
+def mul(a, b):
+    """a · b (mod p), normalized.  Inputs must be normalized (limbs < 2^13).
+
+    Schoolbook: column k = Σ_{i+j=k} a_i·b_j, built as 20 shifted
+    whole-vector multiply-adds (a_i · b contributes to columns i..i+19) —
+    20 medium XLA ops instead of 400 scalar-limb ops, which keeps both the
+    compiled graph small and every op a full-width VPU vector op.  The 39
+    wide columns are carried first (so every column < 2^13 before folding),
+    then columns k ≥ 20 fold into k - 20 with weight 608 (2^260 ≡ 608),
+    then a final three-pass normalization."""
+    wide = None
+    pad_spec = [(0, 0)] * a.ndim
+    for i in range(NLIMBS):
+        part = a[i][None, ...] * b  # (NLIMBS, ...) = a_i · b_j for all j
+        pad_spec[0] = (i, NLIMBS - 1 - i)
+        shifted = jnp.pad(part, pad_spec)  # place at columns i..i+19
+        wide = shifted if wide is None else wide + shifted
+    cols = [wide[k] for k in range(WIDE - 1)]
+    # Serial carry over the 39 wide columns: each becomes < 2^13; the escape
+    # carry (< 2^18, since columns < 2^31) joins as column 39.
+    cols, c = _carry_pass(cols)
+    cols.append(c)
+    # Fold columns 20..39 into 0..19: weight 2^(13k) = 2^(13(k-20))·2^260
+    # ≡ 2^(13(k-20))·608 (mod p).  Max addend 608·2^18 < 2^28: still int32.
+    low = cols[:NLIMBS]
+    for k in range(NLIMBS, len(cols)):
+        low[k - NLIMBS] = low[k - NLIMBS] + cols[k] * FOLD
+    return carry(jnp.stack(low), passes=3)
+
+
+def mul_small(a, k: int):
+    """a · k for a small nonneg constant k < 2^17 (e.g. 2): products
+    < 2^13·2^17 = 2^30 < 2^31.  Normalized output."""
+    return carry(a * jnp.int32(k), passes=2)
+
+
+def select(mask, a, b):
+    """Elementwise where over limb tensors; `mask` broadcasts against the
+    batch axes (limb axis prepended automatically)."""
+    return jnp.where(mask[None, ...], a, b)

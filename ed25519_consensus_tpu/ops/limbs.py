@@ -1,0 +1,99 @@
+"""Host-side packing between exact Python ints and the device limb format.
+
+Device representation (chosen for the TPU VPU, see SURVEY.md §2.2 N1 and
+/opt/skills/guides/pallas_guide.md):
+
+* A field element is **20 limbs of 13 bits** stored in int32.  TPU has no
+  64-bit integer multiply; 13-bit limbs keep every schoolbook partial product
+  below 2^26 and a full 20-term column accumulation below 20·2^26 < 2^31, so
+  int32 never overflows (proof in jnp_field.py).
+* Arrays are laid out limb-major with the batch on the LAST axis — the TPU
+  lane dimension (128 lanes) — so every limb op is a full-width vector op:
+  field element batch = (20, N) int32, point batch = (4, 20, N) for
+  extended coordinates (X, Y, Z, T).
+* Scalars ship as MSB-first bit planes (NBITS, N) int32 for the scan-based
+  double-and-add MSM.
+"""
+
+import numpy as np
+
+NLIMBS = 20
+LIMB_BITS = 13
+LIMB_MASK = (1 << LIMB_BITS) - 1
+# 2^260 = 2^(13·20) ≡ 19·2^5 = 608 (mod p): the fold constant for carries
+# escaping the top limb.
+FOLD = 608
+# Verification scalars are < ℓ < 2^253.
+SCALAR_BITS = 253
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Pack a field element (int in [0, 2^260)) into 20×13-bit limbs."""
+    out = np.empty(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value too large for 260-bit limb format")
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Unpack (possibly unnormalized, possibly signed) limbs to an int."""
+    acc = 0
+    for i in reversed(range(len(limbs))):
+        acc = (acc << LIMB_BITS) + int(limbs[i])
+    return acc
+
+
+def pack_field_batch(values) -> np.ndarray:
+    """Pack a list of field ints into a (NLIMBS, N) int32 array."""
+    n = len(values)
+    out = np.empty((NLIMBS, n), dtype=np.int32)
+    for j, v in enumerate(values):
+        out[:, j] = int_to_limbs(v)
+    return out
+
+
+def pack_point_batch(points) -> np.ndarray:
+    """Pack host extended-coordinate Points into (4, NLIMBS, N) int32."""
+    from .field import P
+
+    n = len(points)
+    out = np.empty((4, NLIMBS, n), dtype=np.int32)
+    for j, pt in enumerate(points):
+        out[0, :, j] = int_to_limbs(pt.X % P)
+        out[1, :, j] = int_to_limbs(pt.Y % P)
+        out[2, :, j] = int_to_limbs(pt.Z % P)
+        out[3, :, j] = int_to_limbs(pt.T % P)
+    return out
+
+
+def unpack_point(arr) -> "object":
+    """Unpack a single device point (4, NLIMBS) back to an exact host Point.
+    Limbs may be unnormalized; the host reduces mod p exactly."""
+    from .edwards import Point
+    from .field import P
+
+    coords = [limbs_to_int(np.asarray(arr[c])) % P for c in range(4)]
+    return Point(*coords)
+
+
+def pack_scalar_bits(scalars, nbits: int = SCALAR_BITS) -> np.ndarray:
+    """Pack scalars into MSB-first bit planes (nbits, N) int32."""
+    n = len(scalars)
+    out = np.zeros((nbits, n), dtype=np.int32)
+    for j, s in enumerate(scalars):
+        if s >> nbits:
+            raise ValueError(f"scalar exceeds {nbits} bits")
+        for t in range(nbits):
+            out[t, j] = (s >> (nbits - 1 - t)) & 1
+    return out
+
+
+def identity_point_batch(n: int) -> np.ndarray:
+    """(4, NLIMBS, n) batch of the identity (0 : 1 : 1 : 0)."""
+    out = np.zeros((4, NLIMBS, n), dtype=np.int32)
+    out[1, 0, :] = 1
+    out[2, 0, :] = 1
+    return out

@@ -1,0 +1,128 @@
+"""Device multiscalar multiplication Σ[c_i]P_i — the batch-verification hot
+path (reference src/batch.rs:207-210), rebuilt TPU-first.
+
+Shape of the computation (SURVEY.md §2.3): the MSM terms are embarrassingly
+parallel over the batch (lane) axis, with one commutative Edwards-group
+reduction at the end.  The kernel is a single `lax.scan` over the 253 scalar
+bit planes (MSB first):
+
+    acc ← 2·acc ;  acc ← acc + (bit ? P : identity)
+
+using the COMPLETE addition law, so identity padding and torsion points need
+no branches — the whole scan is straight-line vector int32 code, then a
+log2(N) tree reduction in the group.  No data-dependent control flow, fully
+static shapes: exactly what XLA/TPU wants.
+
+The host wrapper pads the term list to a power-of-two lane count with
+(scalar=0, point=identity) terms — [0]P = identity makes padding harmless —
+and unpacks the single resulting point back to exact host integers.  All
+accept/reject logic stays on the host (batch.py)."""
+
+import functools
+
+import numpy as np
+
+from . import limbs
+from .edwards import Point
+
+_MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+# Lane-group width of the returned partial sums.  The kernel reduces N terms
+# to at most this many group partial sums; the exact host fold of ≤128 points
+# costs ~milliseconds and keeps the compiled graph SIZE-INDEPENDENT of N
+# (just two lax.scan bodies — no unrolled log2(N) reduction tree, which
+# dominated compile time in the naive version).
+GROUP_LANES = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_kernel(n_lanes: int, nbits: int):
+    """Build and jit the MSM kernel for a fixed (lane count, bit count).
+
+    Stage 1: lax.scan over the nbits bit planes (MSB first):
+             acc ← 2·acc + (bit ? P : identity), lanes = N.
+    Stage 2: if N > GROUP_LANES, a second scan folds the (N/G) lane groups
+             pairwise into one (4, NLIMBS, G) partial-sum block.
+    Returns (4, NLIMBS, G) partial sums; the caller folds them exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import jnp_edwards as E
+    from .limbs import NLIMBS
+
+    G = min(n_lanes, GROUP_LANES)
+    assert n_lanes % G == 0
+
+    def kernel(bits, points):
+        # bits: (nbits, N) int32 bit planes, MSB first
+        # points: (4, NLIMBS, N) int32
+        ident = E.identity_like(points)
+
+        def bit_body(acc, bit_row):
+            acc = E.point_double(acc)
+            addend = E.point_select(bit_row.astype(bool), points, ident)
+            return E.point_add(acc, addend), None
+
+        acc, _ = jax.lax.scan(bit_body, ident, bits)
+
+        if n_lanes > G:
+            blocks = acc.reshape(4, NLIMBS, n_lanes // G, G)
+            blocks = jnp.moveaxis(blocks, 2, 0)  # (L, 4, NLIMBS, G)
+
+            def fold_body(acc_g, block):
+                return E.point_add(acc_g, block), None
+
+            acc, _ = jax.lax.scan(
+                fold_body, E.identity_like(blocks[0]), blocks
+            )
+        return acc  # (4, NLIMBS, G)
+
+    return jax.jit(kernel)
+
+
+def pack_msm_operands(scalars, points, n_lanes: int | None = None):
+    """Pack (scalars, host Points) into padded device operands.
+
+    Returns (bits, point_limbs) numpy arrays of shapes
+    (SCALAR_BITS, N) / (4, NLIMBS, N) with N = next_pow2(len) ≥ _MIN_LANES.
+    Padding terms are scalar 0 on the identity point."""
+    scalars = [int(s) for s in scalars]
+    if len(scalars) != len(points):
+        raise ValueError("scalar/point length mismatch")
+    n = len(scalars)
+    N = n_lanes if n_lanes is not None else max(_MIN_LANES, _next_pow2(n))
+    if N < n or N & (N - 1):
+        raise ValueError("n_lanes must be a power of two ≥ len(scalars)")
+    bits = np.zeros((limbs.SCALAR_BITS, N), dtype=np.int32)
+    bits[:, :n] = limbs.pack_scalar_bits(scalars)
+    pts = limbs.identity_point_batch(N)
+    if n:
+        pts[..., :n] = limbs.pack_point_batch(points)
+    return bits, pts
+
+
+def device_msm(scalars, points) -> Point:
+    """Exact Σ[c_i]P_i computed on the default JAX device; returns a host
+    Point (projective coordinates, unnormalized Z).  Scalars must be
+    < 2^253 (verification scalars are reduced mod ℓ by staging).
+
+    The device returns ≤ GROUP_LANES partial sums which are folded exactly
+    on the host — the group reduction is commutative/associative, so lane
+    order never affects the result."""
+    if not len(scalars):
+        return Point(0, 1, 1, 0)
+    bits, pts = pack_msm_operands(scalars, points)
+    kernel = _compiled_kernel(bits.shape[1], bits.shape[0])
+    out = np.asarray(kernel(bits, pts))
+    acc = limbs.unpack_point(out[..., 0])
+    for g in range(1, out.shape[-1]):
+        acc = acc.add(limbs.unpack_point(out[..., g]))
+    return acc

@@ -1,0 +1,126 @@
+"""Device-path exactness: the JAX limb kernels must agree bit-for-bit (as
+group elements) with the exact host arithmetic, including on the adversarial
+small-order/non-canonical inputs of the conformance matrix (SURVEY.md §7
+stage 5 gate).  Runs on the CPU backend (tests/conftest.py) so CI needs no
+TPU; the same code paths run unchanged on TPU."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import InvalidSignature, Signature, SigningKey, batch
+from ed25519_consensus_tpu.ops import edwards, field, limbs
+from ed25519_consensus_tpu.ops.scalar import L
+
+rng = random.Random(0xDE71CE)
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+# Adversarial field values: boundaries, fold constants, near-p values.
+EDGE_VALUES = [0, 1, 2, 19, 608, field.P - 1, field.P - 2, field.P - 19,
+               (1 << 255) - 20, (1 << 253), 8191, 8192]
+
+
+def _field_batch(n):
+    vals = EDGE_VALUES + [rng.randrange(field.P) for _ in range(n)]
+    return vals
+
+
+def test_field_op_parity():
+    from ed25519_consensus_tpu.ops import jnp_field as F
+
+    a = _field_batch(52)
+    b = list(reversed(_field_batch(52)))
+    A = jnp.asarray(limbs.pack_field_batch(a))
+    B = jnp.asarray(limbs.pack_field_batch(b))
+    for name, fd, fh in [
+        ("add", F.add, field.add),
+        ("sub", F.sub, field.sub),
+        ("mul", F.mul, field.mul),
+    ]:
+        out = np.asarray(fd(A, B))
+        for j in range(len(a)):
+            got = limbs.limbs_to_int(out[:, j]) % field.P
+            assert got == fh(a[j], b[j]), (name, j, a[j], b[j])
+
+
+def test_point_op_parity():
+    from ed25519_consensus_tpu.ops import jnp_edwards as E
+
+    tors = edwards.eight_torsion()
+    pts1 = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, L)) for _ in range(8)]
+    pts1 += tors
+    pts2 = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, L)) for _ in range(8)]
+    pts2 += list(reversed(tors))
+    P1 = jnp.asarray(limbs.pack_point_batch(pts1))
+    P2 = jnp.asarray(limbs.pack_point_batch(pts2))
+    S = np.asarray(E.point_add(P1, P2))
+    Dbl = np.asarray(E.point_double(P1))
+    for j in range(len(pts1)):
+        assert limbs.unpack_point(S[..., j]) == pts1[j].add(pts2[j])
+        assert limbs.unpack_point(Dbl[..., j]) == pts1[j].double()
+
+
+def test_device_msm_matches_host():
+    from ed25519_consensus_tpu.ops import msm
+
+    tors = edwards.eight_torsion()
+    for n in (1, 3, 8):
+        pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, L))
+               for _ in range(max(0, n - 2))] + tors[4:4 + min(n, 2)]
+        pts = pts[:n]
+        sc = [rng.randrange(L) for _ in range(n)]
+        # include the zero scalar and scalar 1 edge cases
+        if n >= 2:
+            sc[0] = 0
+            sc[1] = 1
+        assert msm.device_msm(sc, pts) == edwards.multiscalar_mul(sc, pts)
+
+
+def test_batch_verify_device_backend():
+    bv = batch.Verifier()
+    for _ in range(6):
+        sk = SigningKey.new(rng)
+        msg = b"device backend test"
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    bv.verify(rng=rng, backend="device")
+
+
+def test_batch_verify_device_backend_rejects_bad():
+    bv = batch.Verifier()
+    for i in range(6):
+        sk = SigningKey.new(rng)
+        msg = b"device backend test"
+        sig = sk.sign(msg if i != 2 else b"tampered")
+        bv.queue((sk.verification_key_bytes(), sig, msg))
+    with pytest.raises(InvalidSignature):
+        bv.verify_tpu(rng=rng)
+
+
+def test_small_order_matrix_device_parity():
+    """Every conformance-matrix case through the DEVICE path: batch-of-one
+    verdicts must equal the host-path verdicts (all valid under ZIP215).
+    Also queues the full matrix as ONE device batch."""
+    from ed25519_consensus_tpu.utils import fixtures
+
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    s_bytes = b"\x00" * 32
+
+    # Batch-of-one device verdicts for a representative sample (every A
+    # paired with R rotated by a fixed stride keeps it to 14 cases).
+    for i, A_bytes in enumerate(encs):
+        R_bytes = encs[(i * 5 + 3) % len(encs)]
+        bv = batch.Verifier()
+        bv.queue((A_bytes, Signature(R_bytes, s_bytes), b"Zcash"))
+        bv.verify(rng=rng, backend="device")  # ZIP215: must accept
+
+    # The full 196-case matrix as one coalesced device batch.
+    bv = batch.Verifier()
+    for A_bytes in encs:
+        for R_bytes in encs:
+            bv.queue((A_bytes, Signature(R_bytes, s_bytes), b"Zcash"))
+    assert bv.batch_size == 196
+    bv.verify(rng=rng, backend="device")
