@@ -1,0 +1,116 @@
+"""Benchmark harness: batch signature verification throughput on the real
+device (BASELINE.md configs).  Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "sigs/sec/chip", "vs_baseline": N/200000}
+
+The headline config is the Zcash block-sync replay (10k-signature all-valid
+batch, BASELINE.json config 3) through the END-TO-END device path: host
+staging (SHA-512 challenges, ZIP215 decompression, blinder sampling,
+coalescing, limb packing) + device MSM + host cofactored identity check.
+`--config` selects the other BASELINE configs; `--backend` compares the
+pure-host path.  Do NOT force JAX_PLATFORMS here — this must see the real
+TPU."""
+
+import argparse
+import json
+import random
+import sys
+import time
+
+
+def build_batch(config: str, rng):
+    from ed25519_consensus_tpu import SigningKey, batch
+
+    bv = batch.Verifier()
+    if config == "bench32":
+        # reference benches/bench.rs default: 32 sigs, one message
+        msg = b"ed25519consensus"
+        for _ in range(32):
+            sk = SigningKey.new(rng)
+            bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    elif config == "cometbft128":
+        # 128 validator vote sigs, distinct msgs per entry
+        keys = [SigningKey.new(rng) for _ in range(128)]
+        for i, sk in enumerate(keys):
+            msg = b"vote/height=12345/round=0/val=%d" % i
+            bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    elif config == "zcash10k":
+        # 10k-sig all-valid batch; 64 distinct keys (block-sync replay)
+        keys = [SigningKey.new(rng) for _ in range(64)]
+        for i in range(10_000):
+            sk = keys[i % 64]
+            msg = b"zcash-tx-%d" % i
+            bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    elif config == "adversarial":
+        # small-order/non-canonical (valid under ZIP215) + random valid sigs
+        from ed25519_consensus_tpu import Signature
+        from ed25519_consensus_tpu.ops import edwards
+        from ed25519_consensus_tpu.utils import fixtures
+
+        encs = [p.compress() for p in edwards.eight_torsion()]
+        encs += fixtures.non_canonical_point_encodings()[:6]
+        for A in encs:
+            for R in encs:
+                bv.queue((A, Signature(R, b"\x00" * 32), b"Zcash"))
+        for i in range(196):
+            sk = SigningKey.new(rng)
+            msg = b"adv-%d" % i
+            bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    else:
+        raise ValueError(f"unknown config {config!r}")
+    return bv
+
+
+def rebuild_fresh(bv):
+    """Clone the queued signatures into a fresh Verifier (verification is
+    one-shot in spirit; staging cost must be measured every run)."""
+    from ed25519_consensus_tpu import batch
+
+    nv = batch.Verifier()
+    nv.signatures = {k: list(v) for k, v in bv.signatures.items()}
+    nv.batch_size = bv.batch_size
+    return nv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="zcash10k",
+                    choices=["bench32", "cometbft128", "zcash10k",
+                             "adversarial"])
+    ap.add_argument("--backend", default="device",
+                    choices=["device", "host", "sharded"])
+    ap.add_argument("--runs", type=int, default=3)
+    args = ap.parse_args()
+
+    rng = random.Random(0xBE7C)
+    t0 = time.time()
+    bv = build_batch(args.config, rng)
+    n = bv.batch_size
+    print(f"# built {args.config}: {n} sigs, {len(bv.signatures)} keys "
+          f"in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # Warmup (compiles the kernel for this batch's padded lane count).
+    t0 = time.time()
+    rebuild_fresh(bv).verify(rng=rng, backend=args.backend)
+    print(f"# warmup (compile+run): {time.time()-t0:.1f}s", file=sys.stderr)
+
+    best = float("inf")
+    for _ in range(args.runs):
+        fresh = rebuild_fresh(bv)
+        t0 = time.time()
+        fresh.verify(rng=rng, backend=args.backend)
+        dt = time.time() - t0
+        best = min(best, dt)
+        print(f"# run: {dt:.3f}s -> {n/dt:.0f} sigs/s", file=sys.stderr)
+
+    value = n / best
+    print(json.dumps({
+        "metric": f"batch_verify_sigs_per_sec[{args.config},{args.backend}]",
+        "value": round(value, 1),
+        "unit": "sigs/sec/chip",
+        "vs_baseline": round(value / 200_000, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -140,10 +140,17 @@ class Verifier:
         """Verify all queued signatures; raises InvalidSignature unless ALL
         are valid (reference src/batch.rs:149-217).
 
-        `backend` selects where the bulk MSM runs: "host" (exact Straus) or
-        "device" (TPU/JAX limb kernel; verdict-equivalent by construction —
-        the exact-arithmetic parity is pinned by tests/test_device_parity.py).
-        """
+        `backend` selects where the bulk MSM runs:
+
+        * "host" — exact Straus on the CPU;
+        * "device" — the TPU/JAX limb kernel on the default device;
+        * "sharded" — the multi-chip path: terms sharded over the full
+          device mesh with an ICI all-reduce of partial Edwards sums
+          (parallel/sharded_msm.py).
+
+        All three are verdict-equivalent by construction — the
+        exact-arithmetic parity is pinned by tests/test_device_parity.py
+        and tests/test_sharding.py."""
         scalars, points = self._stage(rng)
         if backend == "host":
             check = edwards.multiscalar_mul(scalars, points)
@@ -155,6 +162,14 @@ class Verifier:
                     "device MSM backend unavailable: " + str(e)
                 ) from e
             check = msm.device_msm(scalars, points)
+        elif backend == "sharded":
+            try:
+                from .parallel import sharded_msm
+            except ImportError as e:
+                raise NotImplementedError(
+                    "sharded MSM backend unavailable: " + str(e)
+                ) from e
+            check = sharded_msm.sharded_device_msm(scalars, points)
         else:
             raise ValueError(f"unknown backend {backend!r}")
         # Final cofactored identity check: host-exact, always.

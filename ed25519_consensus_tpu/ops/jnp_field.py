@@ -1,115 +1,136 @@
 """GF(2^255 - 19) arithmetic on int32 limb tensors (JAX/XLA, TPU-first).
 
-Elements are (NLIMBS, ...) int32 tensors of 13-bit limbs (see limbs.py); all
-ops are elementwise/vector ops on the trailing batch axes — on TPU they run
-full-width on the VPU lanes, and everything fuses under jit.
+Elements are (NLIMBS, ...) int32 tensors of radix-2^13 limbs (limbs.py); all
+ops are whole-tensor vector ops on the trailing batch axes — on TPU they run
+full-width on the VPU lanes and fuse under jit.  Two design decisions keep
+both the compiled graph SMALL (compile time) and the dependency chains
+SHORT (runtime):
 
-Overflow discipline (int32, signed):
+**Balanced signed limbs.**  The working representation allows any limb in
+[-8191, 8191]; ops emit limbs in roughly [-4096, 4096+fold] (carrying uses
+the BALANCED digit split c = (x + 4096) >> 13, r = x - (c << 13), so
+|r| ≤ 4096).  Freshly packed host values (limbs in [0, 2^13)) satisfy the
+same uniform bound
 
-* **normalized**: every limb in [0, 2^13).
-* mul: schoolbook on normalized inputs — each partial product
-  < 2^26, each of the 39 columns sums ≤ 20 partial products < 20·2^26 <
-  2^30.33 < 2^31 - 1.  ✓
-* carry chains use arithmetic shifts, so intermediate NEGATIVE limbs
-  (from sub) are handled: t >> 13 floors, t & 0x1fff extracts a nonneg
-  residue, and t == (t >> 13)·2^13 + (t & 0x1fff) holds for all int32 t.
-* carries escaping limb 19 have weight 2^260 ≡ 608 (mod p) and are folded
-  back into limb 0 (2^260 - 608 = 32p, so the fold subtracts a multiple of
-  p — valid for carries of either sign).
-* `_carry` runs THREE passes after mul/sub (two after add): pass 1 bounds
-  all limbs to [0, 2^13) with a fold of at most ±2^18·608 < 2^28 into
-  limb 0; pass 2 re-normalizes with a fold of at most ±608; pass 3 clears
-  the final ripple.  Exactness (not just plausibility) is pinned by
-  tests/test_device_parity.py against the exact host field on random and
-  adversarial inputs.
+    U:  |limb_i| ≤ 8191,
 
-Everything here computes values CONGRUENT mod p, not canonical residues;
-canonicalization happens on the host after unpacking (limbs.py), which is
-where all consensus decisions live.
+and every op maps U inputs to U outputs (closure proofs below).
+
+**Parallel carries.**  Carrying is done with data-parallel relaxation steps
+(every limb emits a carry simultaneously; carries shift up one limb; the
+top escape folds into limb 0 with weight 2^260 ≡ 608 mod p, valid for
+either sign since 2^260 - 608 = 32p).  Each step is ~6 whole-tensor ops
+with a dependency chain of 1, versus a 20-long serial chain; magnitudes
+shrink by ~2^13 per step, so a constant step count suffices:
+
+* add/sub: |x| ≤ 2·8191; one step → |r| ≤ 4096, carries ≤ 2, escape fold
+  ≤ 2·608 ⇒ |out| ≤ 4096 + 2 + 1216 = 5314 ⊂ U.  ✓
+* mul_small (k ≤ 4): |x| ≤ 4·8191; one step ⇒ |out| ≤ 4096 + 4 + 4·608 =
+  6532 ⊂ U.  ✓
+* mul: schoolbook columns |col_k| ≤ 20·8191² < 1.35e9 < 2^31 (int32 safe).
+  Two wide steps bound the 41 columns to ≤ 4096 + 9 (first step leaves
+  ≤ 4096 + 1.35e9/2^13 ≈ 2^17.4, second ≤ 4096 + 9).  Folding columns
+  20..39 into 0..19 (weight 608·2^(13(k-20))) and the wide escape column
+  40 (|·| ≤ ~20) into column 0 with weight 608² gives |low| < 1.0e7;
+  five more relaxation steps shrink the limb-0 escape chain
+  1.0e7 → 7.4e5 → 5.9e4 → 8.4e3 → 4.7e3 ⊂ U.  ✓
+
+The schoolbook product itself is ONE outer product plus a skew-reshape that
+sums anti-diagonals (wide[k] = Σ_{i+j=k} a_i b_j) — ~6 XLA ops instead of
+hundreds, which is what makes point-op graphs cheap to compile.
+
+Values are CONGRUENT mod p, not canonical; canonicalization happens on the
+host after unpacking (limbs.py), where all consensus decisions live.
+Exactness is pinned by tests/test_device_parity.py against the exact host
+field on random and adversarial inputs, and by the full conformance matrix
+through the device MSM.
 """
 
 import jax.numpy as jnp
 
-from .limbs import FOLD, LIMB_BITS, LIMB_MASK, NLIMBS
+from .limbs import FOLD, LIMB_BITS, NLIMBS
 
-WIDE = 2 * NLIMBS  # columns of a schoolbook product (indices 0..38, +carry)
-
-
-def _carry_pass(limbs):
-    """One serial carry pass over a list of per-limb tensors; returns
-    normalized-limb list plus the carry escaping the top limb."""
-    out = []
-    c = None
-    for k in range(len(limbs)):
-        t = limbs[k] if c is None else limbs[k] + c
-        out.append(t & LIMB_MASK)
-        c = t >> LIMB_BITS
-    return out, c
+_HALF = 1 << (LIMB_BITS - 1)  # 4096: balanced-digit rounding offset
 
 
-def _fold_carry(limbs, c):
-    """Fold a carry of weight 2^260 back into limb 0 (≡ ·608 mod p)."""
-    limbs = list(limbs)
-    limbs[0] = limbs[0] + c * FOLD
-    return limbs
+def _carry_step(x, fold_escape: bool):
+    """One parallel carry relaxation step over the leading limb axis.
+    Every limb splits into a balanced residue and a carry; carries shift up
+    one limb; if `fold_escape`, the top carry folds into limb 0 (·608),
+    otherwise the caller must have a zero top limb to absorb it."""
+    c = (x + _HALF) >> LIMB_BITS
+    r = x - (c << LIMB_BITS)
+    if fold_escape:
+        # one concatenate carries limbs up AND folds the escape into limb 0
+        # (no scatter ops — they lower poorly on TPU)
+        shifted = jnp.concatenate([c[-1:] * FOLD, c[:-1]], axis=0)
+    else:
+        shifted = jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    return r + shifted
 
 
-def carry(x, passes: int):
-    """Normalize a (NLIMBS, ...) limb tensor: `passes` carry passes, folding
-    top-limb escapes mod p each time.  See module docstring for why 2 or 3
-    passes suffice per op."""
-    limbs = [x[i] for i in range(NLIMBS)]
-    for _ in range(passes):
-        limbs, c = _carry_pass(limbs)
-        limbs = _fold_carry(limbs, c)
-    return jnp.stack(limbs)
+def carry(x, steps: int):
+    """`steps` parallel carry steps with mod-p escape folding; see module
+    docstring for per-op step counts and bounds."""
+    for _ in range(steps):
+        x = _carry_step(x, fold_escape=True)
+    return x
 
 
 def add(a, b):
-    """a + b (mod p), normalized.  Inputs must be normalized."""
-    return carry(a + b, passes=2)
+    """a + b (mod p) in U.  One carry step (closure proof in module doc)."""
+    return carry(a + b, steps=1)
 
 
 def sub(a, b):
-    """a - b (mod p), normalized.  Signed intermediates are fine (arithmetic
-    shifts); three passes absorb the worst-case negative ripple."""
-    return carry(a - b, passes=3)
+    """a - b (mod p) in U.  Balanced signed limbs make subtraction
+    symmetric with addition — no borrow special-casing."""
+    return carry(a - b, steps=1)
 
 
 def mul(a, b):
-    """a · b (mod p), normalized.  Inputs must be normalized (limbs < 2^13).
+    """a · b (mod p) in U.
 
-    Schoolbook: column k = Σ_{i+j=k} a_i·b_j, built as 20 shifted
-    whole-vector multiply-adds (a_i · b contributes to columns i..i+19) —
-    20 medium XLA ops instead of 400 scalar-limb ops, which keeps both the
-    compiled graph small and every op a full-width VPU vector op.  The 39
-    wide columns are carried first (so every column < 2^13 before folding),
-    then columns k ≥ 20 fold into k - 20 with weight 608 (2^260 ≡ 608),
-    then a final three-pass normalization."""
-    wide = None
-    pad_spec = [(0, 0)] * a.ndim
-    for i in range(NLIMBS):
-        part = a[i][None, ...] * b  # (NLIMBS, ...) = a_i · b_j for all j
-        pad_spec[0] = (i, NLIMBS - 1 - i)
-        shifted = jnp.pad(part, pad_spec)  # place at columns i..i+19
-        wide = shifted if wide is None else wide + shifted
-    cols = [wide[k] for k in range(WIDE - 1)]
-    # Serial carry over the 39 wide columns: each becomes < 2^13; the escape
-    # carry (< 2^18, since columns < 2^31) joins as column 39.
-    cols, c = _carry_pass(cols)
-    cols.append(c)
-    # Fold columns 20..39 into 0..19: weight 2^(13k) = 2^(13(k-20))·2^260
-    # ≡ 2^(13(k-20))·608 (mod p).  Max addend 608·2^18 < 2^28: still int32.
-    low = cols[:NLIMBS]
-    for k in range(NLIMBS, len(cols)):
-        low[k - NLIMBS] = low[k - NLIMBS] + cols[k] * FOLD
-    return carry(jnp.stack(low), passes=3)
+    wide[k] = Σ_{i+j=k} a_i·b_j via one outer product and the skew trick:
+    pad the j-axis of the (20, 20, ...) outer product to 40, flatten (i, j)
+    and re-slice as (20, 39, ...) — row i lands shifted by i, so summing
+    over rows yields the 39 anti-diagonal column sums."""
+    trailing = a.shape[1:]
+    outer = a[:, None] * b[None, :]  # (20, 20, ...)
+    pad_spec = [(0, 0)] * outer.ndim
+    pad_spec[1] = (0, NLIMBS)
+    padded = jnp.pad(outer, pad_spec)  # (20, 40, ...)
+    flat = padded.reshape((NLIMBS * 2 * NLIMBS,) + trailing)
+    skew = flat[: NLIMBS * (2 * NLIMBS - 1)].reshape(
+        (NLIMBS, 2 * NLIMBS - 1) + trailing
+    )
+    wide = jnp.sum(skew, axis=0)  # (39, ...)
+    # two zero columns absorb the wide-phase carries (no fold needed yet)
+    wide = jnp.concatenate(
+        [wide, jnp.zeros((2,) + trailing, dtype=wide.dtype)], axis=0
+    )  # (41, ...)
+    wide = _carry_step(wide, fold_escape=False)
+    wide = _carry_step(wide, fold_escape=False)
+    # Fold columns 20..39 into 0..19 (weight 2^(13k) ≡ 608·2^(13(k-20)))
+    # and column 40 — the wide-carry escape, |·| ≤ ~20 — into column 0
+    # with weight 2^520 ≡ 608² (mod p).
+    low = wide[:NLIMBS] + wide[NLIMBS : 2 * NLIMBS] * FOLD
+    esc = jnp.concatenate(
+        [wide[2 * NLIMBS :] * (FOLD * FOLD),
+         jnp.zeros((NLIMBS - 1,) + trailing, dtype=wide.dtype)],
+        axis=0,
+    )
+    low = low + esc
+    # |low| ≤ 4105 + 608·4105 + 608²·20 < 1.0e7; five relaxation steps
+    # bring the limb-0 escape chain down into U (see module doc).
+    return carry(low, steps=5)
 
 
 def mul_small(a, k: int):
-    """a · k for a small nonneg constant k < 2^17 (e.g. 2): products
-    < 2^13·2^17 = 2^30 < 2^31.  Normalized output."""
-    return carry(a * jnp.int32(k), passes=2)
+    """a · k for constant 2 ≤ k ≤ 4; one carry step (see module doc)."""
+    if not 2 <= k <= 4:
+        raise ValueError("mul_small supports 2 ≤ k ≤ 4")
+    return carry(a * jnp.int32(k), steps=1)
 
 
 def select(mask, a, b):

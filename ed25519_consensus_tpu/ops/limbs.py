@@ -46,27 +46,34 @@ def limbs_to_int(limbs) -> int:
     return acc
 
 
+def _ints_to_bits(values, nbytes: int) -> np.ndarray:
+    """(N, 8*nbytes) little-endian bit matrix from a list of ints, built
+    via bytes + np.unpackbits (vectorized; the per-int Python cost is one
+    to_bytes call)."""
+    raw = b"".join(v.to_bytes(nbytes, "little") for v in values)
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(len(values), nbytes)
+    return np.unpackbits(arr, axis=1, bitorder="little")
+
+
+# (13,) bit weights for assembling one limb from its bit window.
+_LIMB_WEIGHTS = (1 << np.arange(LIMB_BITS, dtype=np.int64)).astype(np.int32)
+
+
 def pack_field_batch(values) -> np.ndarray:
-    """Pack a list of field ints into a (NLIMBS, N) int32 array."""
-    n = len(values)
-    out = np.empty((NLIMBS, n), dtype=np.int32)
-    for j, v in enumerate(values):
-        out[:, j] = int_to_limbs(v)
-    return out
+    """Pack a list of field ints (< 2^260) into a (NLIMBS, N) int32 array.
+    Vectorized: bits → (N, NLIMBS, 13) → weighted sum."""
+    bits = _ints_to_bits(values, 33)[:, : NLIMBS * LIMB_BITS]
+    limbs13 = bits.reshape(len(values), NLIMBS, LIMB_BITS).astype(np.int32)
+    return (limbs13 @ _LIMB_WEIGHTS).T.copy()
 
 
 def pack_point_batch(points) -> np.ndarray:
     """Pack host extended-coordinate Points into (4, NLIMBS, N) int32."""
     from .field import P
 
-    n = len(points)
-    out = np.empty((4, NLIMBS, n), dtype=np.int32)
-    for j, pt in enumerate(points):
-        out[0, :, j] = int_to_limbs(pt.X % P)
-        out[1, :, j] = int_to_limbs(pt.Y % P)
-        out[2, :, j] = int_to_limbs(pt.Z % P)
-        out[3, :, j] = int_to_limbs(pt.T % P)
-    return out
+    coords = [[pt.X % P for pt in points], [pt.Y % P for pt in points],
+              [pt.Z % P for pt in points], [pt.T % P for pt in points]]
+    return np.stack([pack_field_batch(c) for c in coords])
 
 
 def unpack_point(arr) -> "object":
@@ -80,15 +87,30 @@ def unpack_point(arr) -> "object":
 
 
 def pack_scalar_bits(scalars, nbits: int = SCALAR_BITS) -> np.ndarray:
-    """Pack scalars into MSB-first bit planes (nbits, N) int32."""
-    n = len(scalars)
-    out = np.zeros((nbits, n), dtype=np.int32)
-    for j, s in enumerate(scalars):
+    """Pack scalars into MSB-first bit planes (nbits, N) int32
+    (vectorized via np.unpackbits)."""
+    nbytes = (nbits + 7) // 8
+    for s in scalars:
         if s >> nbits:
             raise ValueError(f"scalar exceeds {nbits} bits")
-        for t in range(nbits):
-            out[t, j] = (s >> (nbits - 1 - t)) & 1
-    return out
+    bits = _ints_to_bits(scalars, nbytes)[:, :nbits]
+    # little-endian bit index -> MSB-first plane order, terms on lanes
+    return bits[:, ::-1].T.astype(np.int32).copy()
+
+
+WINDOW_BITS = 4
+NWINDOWS = 64  # radix-16 windows covering 256 bits
+
+
+def pack_scalar_windows(scalars) -> np.ndarray:
+    """Pack scalars (< 2^256) into MSB-first radix-16 digit planes
+    (NWINDOWS, N) int32 (vectorized via np.unpackbits)."""
+    bits = _ints_to_bits(scalars, 32)  # (N, 256) little-endian bits
+    w = (1 << np.arange(WINDOW_BITS, dtype=np.int32)).astype(np.int32)
+    digits = bits.reshape(len(scalars), NWINDOWS, WINDOW_BITS).astype(
+        np.int32
+    ) @ w  # (N, NWINDOWS) little-endian window order
+    return digits[:, ::-1].T.copy()
 
 
 def identity_point_batch(n: int) -> np.ndarray:

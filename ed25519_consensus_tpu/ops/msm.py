@@ -1,17 +1,32 @@
 """Device multiscalar multiplication Σ[c_i]P_i — the batch-verification hot
 path (reference src/batch.rs:207-210), rebuilt TPU-first.
 
-Shape of the computation (SURVEY.md §2.3): the MSM terms are embarrassingly
-parallel over the batch (lane) axis, with one commutative Edwards-group
-reduction at the end.  The kernel is a single `lax.scan` over the 253 scalar
-bit planes (MSB first):
+Algorithm: **transposed windowed Straus**.  Writing each scalar in 64
+radix-16 windows c_i = Σ_w 16^(63-w)·d_{i,w}:
 
-    acc ← 2·acc ;  acc ← acc + (bit ? P : identity)
+    Σ_i [c_i]P_i  =  Σ_w 16^(63-w) · S_w,    S_w = Σ_i [d_{i,w}] T_i
 
-using the COMPLETE addition law, so identity padding and torsion points need
-no branches — the whole scan is straight-line vector int32 code, then a
-log2(N) tree reduction in the group.  No data-dependent control flow, fully
-static shapes: exactly what XLA/TPU wants.
+where T_i is the 16-entry multiples table of P_i.  The per-window sums S_w
+for ALL windows are computed together — the window axis just becomes another
+vector axis — so the doublings of the Horner combine run on ONE lane instead
+of per-term: ~(15 table + 64 window-sum) point-add lanes of work per term,
+versus ~506 for naive bit-serial double-and-add.
+
+Kernel stages (each a lax.scan with a fixed-size body, so compile time is
+independent of batch size):
+
+  1. table scan: T_j = T_{j-1} + P (15 steps, N lanes) → (16, 4, NLIMBS, N)
+  2. block scan over N/G lane blocks (G = 128): one-hot-select each term's
+     window digits from its table (exact int32 einsum — a gather with
+     predictable TPU lowering) and point-add into a (4, NLIMBS, 64, G)
+     accumulator: 64 windows × G lanes wide per step.
+  3. a 7-level tree folds G → 1: per-window sums (4, NLIMBS, 64)
+  4. Horner scan over the 64 windows (MSB first): acc ← 16·acc + S_w
+     (4 doublings + 1 add on a single lane per step).
+
+All point ops use the COMPLETE addition law (jnp_edwards), so identity
+padding, zero digits, and torsion points need no branches — no
+data-dependent control flow anywhere (SURVEY.md §2.3).
 
 The host wrapper pads the term list to a power-of-two lane count with
 (scalar=0, point=identity) terms — [0]P = identity makes padding harmless —
@@ -24,8 +39,14 @@ import numpy as np
 
 from . import limbs
 from .edwards import Point
+from .limbs import NLIMBS
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
+
+WINDOW_BITS = 4
+NWINDOWS = 64  # ceil(256 / WINDOW_BITS); scalars up to 2^256 supported
+# Lane-block width of the reduction scan (stage 2/3).
+GROUP_LANES = 128
 
 
 def _next_pow2(n: int) -> int:
@@ -35,55 +56,84 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-# Lane-group width of the returned partial sums.  The kernel reduces N terms
-# to at most this many group partial sums; the exact host fold of ≤128 points
-# costs ~milliseconds and keeps the compiled graph SIZE-INDEPENDENT of N
-# (just two lax.scan bodies — no unrolled log2(N) reduction tree, which
-# dominated compile time in the naive version).
-GROUP_LANES = 128
-
-
 @functools.lru_cache(maxsize=None)
-def _compiled_kernel(n_lanes: int, nbits: int):
-    """Build and jit the MSM kernel for a fixed (lane count, bit count).
-
-    Stage 1: lax.scan over the nbits bit planes (MSB first):
-             acc ← 2·acc + (bit ? P : identity), lanes = N.
-    Stage 2: if N > GROUP_LANES, a second scan folds the (N/G) lane groups
-             pairwise into one (4, NLIMBS, G) partial-sum block.
-    Returns (4, NLIMBS, G) partial sums; the caller folds them exactly."""
+def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
+    """Build and jit the windowed MSM kernel for a fixed lane count.
+    Input: digits (nwin, N) int32 in [0, 16), MSB-first windows;
+           points (4, NLIMBS, N) int32.
+    Output: (4, NLIMBS, 1) — the full MSM sum as one point."""
     import jax
     import jax.numpy as jnp
 
     from . import jnp_edwards as E
-    from .limbs import NLIMBS
 
     G = min(n_lanes, GROUP_LANES)
     assert n_lanes % G == 0
+    n_blocks = n_lanes // G
 
-    def kernel(bits, points):
-        # bits: (nbits, N) int32 bit planes, MSB first
-        # points: (4, NLIMBS, N) int32
-        ident = E.identity_like(points)
+    def kernel(digits, points):
+        # --- stage 1: per-term multiples tables ------------------------
+        def table_body(t, _):
+            nxt = E.point_add(t, points)
+            return nxt, nxt
 
-        def bit_body(acc, bit_row):
-            acc = E.point_double(acc)
-            addend = E.point_select(bit_row.astype(bool), points, ident)
-            return E.point_add(acc, addend), None
+        _, multiples = jax.lax.scan(
+            table_body, E.identity_like(points), None, length=15
+        )  # (15, 4, NLIMBS, N) = [1]P .. [15]P
+        table = jnp.concatenate(
+            [E.identity_like(points)[None], multiples], axis=0
+        )  # (16, 4, NLIMBS, N)
 
-        acc, _ = jax.lax.scan(bit_body, ident, bits)
+        # --- stage 2: per-window sums over lane blocks -----------------
+        tbl_blocks = jnp.moveaxis(
+            table.reshape(16, 4, NLIMBS, n_blocks, G), 3, 0
+        )  # (B, 16, 4, NLIMBS, G)
+        dig_blocks = jnp.moveaxis(
+            digits.reshape(nwin, n_blocks, G), 1, 0
+        )  # (B, nwin, G)
 
-        if n_lanes > G:
-            blocks = acc.reshape(4, NLIMBS, n_lanes // G, G)
-            blocks = jnp.moveaxis(blocks, 2, 0)  # (L, 4, NLIMBS, G)
+        def block_body(acc, xs):
+            tbl, dig = xs
+            onehot = (
+                dig[:, None, :] == jnp.arange(16, dtype=jnp.int32)[None, :, None]
+            ).astype(jnp.int32)  # (nwin, 16, G)
+            # Exact select: for each (window, lane), pick the digit's table
+            # entry.  Broadcast-multiply + sum over the 16-entry axis
+            # (NOT einsum/dot_general — integer dots lower poorly on TPU);
+            # one-hot masking keeps limb magnitudes unchanged.
+            sel = jnp.sum(
+                onehot[None, None] * jnp.moveaxis(tbl, 0, 2)[:, :, None],
+                axis=3,
+            )  # (4, NLIMBS, nwin, G)
+            return E.point_add(acc, sel), None
 
-            def fold_body(acc_g, block):
-                return E.point_add(acc_g, block), None
+        ident_np = np.zeros((4, NLIMBS, nwin, G), dtype=np.int32)
+        ident_np[1, 0] = 1
+        ident_np[2, 0] = 1
+        acc, _ = jax.lax.scan(
+            block_body, jnp.asarray(ident_np), (tbl_blocks, dig_blocks)
+        )
 
-            acc, _ = jax.lax.scan(
-                fold_body, E.identity_like(blocks[0]), blocks
-            )
-        return acc  # (4, NLIMBS, G)
+        # --- stage 3: fold the G lanes (tree) --------------------------
+        g = G
+        while g > 1:
+            half = g // 2
+            acc = E.point_add(acc[..., :half], acc[..., half:])
+            g = half
+        window_sums = acc[..., 0]  # (4, NLIMBS, nwin)
+
+        # --- stage 4: Horner combine over windows (MSB first) ----------
+        sums_seq = jnp.moveaxis(window_sums, -1, 0)[..., None]  # (nwin,4,NL,1)
+
+        def horner_body(a, s_w):
+            for _ in range(WINDOW_BITS):
+                a = E.point_double(a)
+            return E.point_add(a, s_w), None
+
+        out, _ = jax.lax.scan(
+            horner_body, E.identity_like(sums_seq[0]), sums_seq
+        )
+        return out  # (4, NLIMBS, 1)
 
     return jax.jit(kernel)
 
@@ -91,38 +141,34 @@ def _compiled_kernel(n_lanes: int, nbits: int):
 def pack_msm_operands(scalars, points, n_lanes: int | None = None):
     """Pack (scalars, host Points) into padded device operands.
 
-    Returns (bits, point_limbs) numpy arrays of shapes
-    (SCALAR_BITS, N) / (4, NLIMBS, N) with N = next_pow2(len) ≥ _MIN_LANES.
+    Returns (digits, point_limbs) numpy arrays of shapes
+    (NWINDOWS, N) / (4, NLIMBS, N) with N = next_pow2(len) ≥ _MIN_LANES.
     Padding terms are scalar 0 on the identity point."""
     scalars = [int(s) for s in scalars]
     if len(scalars) != len(points):
         raise ValueError("scalar/point length mismatch")
     n = len(scalars)
     N = n_lanes if n_lanes is not None else max(_MIN_LANES, _next_pow2(n))
-    if N < n or N & (N - 1):
-        raise ValueError("n_lanes must be a power of two ≥ len(scalars)")
-    bits = np.zeros((limbs.SCALAR_BITS, N), dtype=np.int32)
-    bits[:, :n] = limbs.pack_scalar_bits(scalars)
+    if N < n:
+        raise ValueError("n_lanes must be ≥ len(scalars)")
+    digits = np.zeros((NWINDOWS, N), dtype=np.int32)
+    if n:
+        digits[:, :n] = limbs.pack_scalar_windows(scalars)
     pts = limbs.identity_point_batch(N)
     if n:
         pts[..., :n] = limbs.pack_point_batch(points)
-    return bits, pts
+    return digits, pts
 
 
 def device_msm(scalars, points) -> Point:
     """Exact Σ[c_i]P_i computed on the default JAX device; returns a host
-    Point (projective coordinates, unnormalized Z).  Scalars must be
-    < 2^253 (verification scalars are reduced mod ℓ by staging).
+    Point (projective coordinates, unnormalized Z).
 
-    The device returns ≤ GROUP_LANES partial sums which are folded exactly
-    on the host — the group reduction is commutative/associative, so lane
-    order never affects the result."""
+    The group reduction is commutative/associative, so lane order never
+    affects the result."""
     if not len(scalars):
         return Point(0, 1, 1, 0)
-    bits, pts = pack_msm_operands(scalars, points)
-    kernel = _compiled_kernel(bits.shape[1], bits.shape[0])
-    out = np.asarray(kernel(bits, pts))
-    acc = limbs.unpack_point(out[..., 0])
-    for g in range(1, out.shape[-1]):
-        acc = acc.add(limbs.unpack_point(out[..., g]))
-    return acc
+    digits, pts = pack_msm_operands(scalars, points)
+    kernel = _compiled_kernel(digits.shape[1], digits.shape[0])
+    out = np.asarray(kernel(digits, pts))
+    return limbs.unpack_point(out[..., 0])

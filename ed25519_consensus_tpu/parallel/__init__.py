@@ -1,0 +1,2 @@
+"""Multi-chip execution: device meshes and the sharded batch-verification
+MSM with its ICI all-reduce of partial Edwards sums (SURVEY.md §2.3)."""

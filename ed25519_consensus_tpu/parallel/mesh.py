@@ -1,0 +1,28 @@
+"""Device mesh helpers.
+
+The workload has exactly one parallel dimension — the MSM term/batch axis —
+so the mesh is 1-D ("batch" = data parallelism over independent group terms;
+reference analog: the sequential loop at src/batch.rs:182-203).  The single
+collective is an all-gather of per-chip partial Edwards sums over ICI
+(SURVEY.md §5 'Distributed communication backend')."""
+
+import jax
+from jax.sharding import Mesh
+
+BATCH_AXIS = "batch"
+
+
+def batch_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first `n_devices` available devices (all by
+    default)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if n_devices > len(devices):
+                raise ValueError(
+                    f"requested {n_devices} devices, have {len(devices)}"
+                )
+            devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), (BATCH_AXIS,))

@@ -1,0 +1,97 @@
+"""Multi-chip MSM: shard the n+m+1 verification terms across a device mesh,
+reduce per-chip partial sums in the Edwards group, all-reduce over ICI.
+
+Design (SURVEY.md §2.3, BASELINE.json north star): the MSM terms are
+independent, so the mesh is 1-D data parallelism over the term axis.  Each
+chip runs the same scan kernel as the single-chip path on its shard and
+reduces it to ONE extended-coordinates point; the partial sums are
+all-gathered (a 4×NLIMBS×1 int32 tensor per chip — a few hundred bytes
+riding ICI) and folded with Edwards addition, which is commutative and
+associative, so any reduction order/tree is valid.  The final cofactor-mul
+and identity check stay on the host (batch.py), as always.
+
+Note the collective is an `all_gather` + group fold rather than `psum`:
+lax.psum would add LIMB TENSORS elementwise, which is not the group
+operation.  The gather is the TPU-native analog of the reference's (absent)
+communication backend — one collective, O(devices) bytes."""
+
+import functools
+
+import numpy as np
+
+from ..ops import limbs
+from ..ops.edwards import Point
+from . import mesh as mesh_lib
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
+                             nbits: int):
+    """jit a shard_map'd MSM over a 1-D batch mesh.
+
+    Input shapes (global): bits (nbits, N), points (4, NLIMBS, N) with
+    N = n_devices * lanes_per_device; output: replicated (4, NLIMBS, 1)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from ..ops import jnp_edwards as E
+    from ..ops import msm as msm_lib
+
+    mesh = mesh_lib.batch_mesh(n_devices)
+    axis = mesh_lib.BATCH_AXIS
+
+    local_kernel = msm_lib._compiled_kernel.__wrapped__(
+        lanes_per_device, nbits
+    )  # un-jitted builder result is already a jit fn; call inside shard_map
+
+    def shard_fn(bits, points):
+        # Per-device shard: (nbits, N/D), (4, NLIMBS, N/D)
+        part = local_kernel(bits, points)  # (4, NLIMBS, 1)
+        # ICI all-reduce in the Edwards group: gather the D partial sums
+        # and fold them with the complete addition law.
+        gathered = jax.lax.all_gather(part, axis)  # (D, 4, NLIMBS, 1)
+
+        def fold(acc, p):
+            return E.point_add(acc, p), None
+
+        out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]), gathered)
+        return out
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, None, axis)),
+        out_specs=P(),  # replicated result
+        check_rep=False,
+    )
+    return jax.jit(fn), mesh
+
+
+def sharded_device_msm(scalars, points, n_devices: int | None = None) -> Point:
+    """Exact Σ[c_i]P_i sharded over `n_devices` (default: all devices).
+    Semantics identical to ops.msm.device_msm; padding terms are
+    (0, identity) and harmless."""
+    import jax
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    if not len(scalars):
+        return Point(0, 1, 1, 0)
+    # Pad the term count to a lane multiple of n_devices * MIN block.
+    n = len(scalars)
+    per_dev = 1
+    while n_devices * per_dev < max(n, 8 * n_devices):
+        per_dev <<= 1
+    N = n_devices * per_dev
+    bits, pts = _pack_padded(scalars, points, N)
+    kernel, _ = _compiled_sharded_kernel(n_devices, per_dev, bits.shape[0])
+    out = np.asarray(kernel(bits, pts))
+    return limbs.unpack_point(out[..., 0])
+
+
+def _pack_padded(scalars, points, N):
+    from ..ops import msm as msm_lib
+
+    return msm_lib.pack_msm_operands(scalars, points, n_lanes=N)

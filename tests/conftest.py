@@ -1,12 +1,20 @@
 """Test configuration: force JAX onto the CPU backend with 8 virtual devices
-BEFORE any jax import, so the multi-chip sharding path is exercised without
-TPU hardware (SURVEY.md §4 build mapping)."""
+so the multi-chip sharding path is exercised without TPU hardware
+(SURVEY.md §4 build mapping).
+
+Note: env vars alone are NOT sufficient in this environment — a site-level
+PJRT plugin can pre-register an accelerator platform and win over
+JAX_PLATFORMS — so we also set the config explicitly after import."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

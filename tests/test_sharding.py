@@ -1,0 +1,56 @@
+"""Multi-chip path: the shard_map MSM over an 8-device (virtual CPU) mesh
+must be exactly equivalent to the host MSM, and the sharded batch-verify
+backend must agree with the host backend (SURVEY.md §7 stage 7)."""
+
+import random
+
+import pytest
+
+from ed25519_consensus_tpu import InvalidSignature, SigningKey, batch
+from ed25519_consensus_tpu.ops import edwards
+from ed25519_consensus_tpu.ops.scalar import L
+
+rng = random.Random(0x5AAD)
+
+jax = pytest.importorskip("jax")
+
+
+def _require_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"need {n} devices, have {len(jax.devices())}")
+
+
+def test_sharded_msm_parity():
+    from ed25519_consensus_tpu.parallel.sharded_msm import sharded_device_msm
+
+    _require_devices(8)
+    B = edwards.BASEPOINT
+    n = 50
+    pts = [B.scalar_mul(rng.randrange(1, L)) for _ in range(n - 2)]
+    pts += edwards.eight_torsion()[5:7]
+    sc = [rng.randrange(L) for _ in range(n)]
+    sc[0] = 0
+    got = sharded_device_msm(sc, pts, n_devices=8)
+    assert got == edwards.multiscalar_mul(sc, pts)
+
+
+def test_sharded_batch_verify():
+    _require_devices(8)
+    bv = batch.Verifier()
+    for _ in range(12):
+        sk = SigningKey.new(rng)
+        msg = b"sharded backend test"
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    bv.verify(rng=rng, backend="sharded")
+
+
+def test_sharded_batch_verify_rejects_bad():
+    _require_devices(8)
+    bv = batch.Verifier()
+    for i in range(12):
+        sk = SigningKey.new(rng)
+        msg = b"sharded backend test"
+        sig = sk.sign(msg if i != 7 else b"tampered")
+        bv.queue((sk.verification_key_bytes(), sig, msg))
+    with pytest.raises(InvalidSignature):
+        bv.verify(rng=rng, backend="sharded")
