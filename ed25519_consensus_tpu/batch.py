@@ -108,16 +108,27 @@ class Verifier:
         term list (scalars, points).  Raises InvalidSignature on ANY
         malformed input — before any device dispatch (all-or-nothing
         semantics, reference src/batch.rs:139-147, 182-203)."""
+        from . import native
+
+        groups = list(self.signatures.items())
+        # One batched (native if available, exact either way) decompression
+        # of all m keys and n R values — the host staging hot spot.
+        encodings = [vkb.to_bytes() for vkb, _ in groups]
+        for _, sigs in groups:
+            encodings.extend(sig.R_bytes for _, sig in sigs)
+        decompressed = native.decompress_batch(encodings)
+        A_points = decompressed[: len(groups)]
+        R_points = iter(decompressed[len(groups) :])
+
         B_coeff = 0
         A_coeffs, As = [], []
         R_coeffs, Rs = [], []
-        for vk_bytes, sigs in self.signatures.items():
-            A = edwards.decompress(vk_bytes.to_bytes())
+        for (vk_bytes, sigs), A in zip(groups, A_points):
             if A is None:
                 raise InvalidSignature()
             A_coeff = 0
             for k, sig in sigs:
-                R = edwards.decompress(sig.R_bytes)
+                R = next(R_points)
                 if R is None:
                     raise InvalidSignature()
                 s = scalar.from_canonical_bytes(sig.s_bytes)

@@ -1,0 +1,115 @@
+"""Native host-staging runtime: C++ batched ZIP215 decompression.
+
+The batch verifier stages n + m point decompressions per batch (reference
+src/batch.rs:182-203); each costs ~30µs in pure Python (one big-int pow for
+the square root), which caps end-to-end throughput long before the device
+MSM does.  This module builds fe25519.cpp with g++ on first use (cached
+next to the source) and binds it with ctypes — no pybind11 in this
+environment (see repo build notes).
+
+Exactness: the C++ path is plain integer arithmetic, bit-identical to the
+Python host field by construction; tests/test_native.py pins parity over
+the conformance fixtures (all 26 non-canonical encodings, 8-torsion,
+rejects) and random points.  If the toolchain or the parity self-check
+fails, callers fall back to the Python path automatically."""
+
+import ctypes
+import os
+import subprocess
+
+_SRC = os.path.join(os.path.dirname(__file__), "fe25519.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_fe25519.so")
+
+_lib = None
+_lib_failed = False
+
+
+def _build() -> str:
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+        _SRC
+    ):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    return _SO
+
+
+def load():
+    """Return the ctypes library, building it if needed; None if
+    unavailable (no toolchain, load failure, or failed self-check)."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        lib = ctypes.CDLL(_build())
+        lib.zip215_decompress_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        lib.zip215_decompress_batch.restype = None
+        _self_check(lib)
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+        _lib = None
+    return _lib
+
+
+def _self_check(lib):
+    """Cheap startup parity check against the exact Python path."""
+    from ..ops import edwards
+
+    cases = [
+        edwards.BASEPOINT.compress(),
+        (1).to_bytes(32, "little"),
+        (2).to_bytes(32, "little"),  # not a point: must be rejected
+    ]
+    got = _decompress_batch_raw(lib, cases)
+    for enc, pt in zip(cases, got):
+        want = edwards.decompress(enc)
+        if (pt is None) != (want is None):
+            raise RuntimeError("native decompress disagreement")
+        if pt is not None and pt != want:
+            raise RuntimeError("native decompress disagreement")
+
+
+def _decompress_batch_raw(lib, encodings):
+    from ..ops.edwards import Point
+
+    n = len(encodings)
+    blob = b"".join(encodings)
+    out = ctypes.create_string_buffer(128 * n)
+    ok = ctypes.create_string_buffer(n)
+    lib.zip215_decompress_batch(blob, n, out, ok)
+    res = []
+    buf = out.raw
+    for i in range(n):
+        if ok.raw[i] == 0:
+            res.append(None)
+            continue
+        o = buf[128 * i : 128 * (i + 1)]
+        res.append(
+            Point(
+                int.from_bytes(o[0:32], "little"),
+                int.from_bytes(o[32:64], "little"),
+                int.from_bytes(o[64:96], "little"),
+                int.from_bytes(o[96:128], "little"),
+            )
+        )
+    return res
+
+
+def decompress_batch(encodings):
+    """Batched ZIP215 decompression: list of 32-byte encodings → list of
+    Point-or-None.  Uses the native library when available, else the exact
+    Python path."""
+    lib = load()
+    if lib is not None:
+        return _decompress_batch_raw(lib, list(encodings))
+    from ..ops import edwards
+
+    return [edwards.decompress(e) for e in encodings]

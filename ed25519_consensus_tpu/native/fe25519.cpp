@@ -1,0 +1,249 @@
+// Native host staging for ed25519-consensus-tpu: batched ZIP215 point
+// decompression (SURVEY.md §2.2 N2, reference call sites
+// src/verification_key.rs:166 and src/batch.rs:183,190).
+//
+// Written from scratch against RFC 8032 §5.1.3 + the ZIP215 acceptance
+// rules (non-canonical y encodings accepted and reduced; x = 0 with sign
+// bit 1 accepted).  Field arithmetic is the standard radix-2^51
+// representation with unsigned __int128 products; everything is exact
+// integer math, so results are bit-identical to the Python host path —
+// parity is pinned by tests/test_native.py over the full conformance
+// fixtures.
+//
+// Plain C ABI (loaded with ctypes; no pybind11 in this environment).
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+namespace {
+
+const u64 MASK51 = (((u64)1) << 51) - 1;
+
+struct fe {
+    u64 v[5];
+};
+
+// d = -121665/121666 mod p, radix-2^51 limbs (little-endian limb order).
+const fe FE_D = {{0x34dca135978a3ULL, 0x1a8283b156ebdULL, 0x5e7a26001c029ULL,
+                  0x739c663a03cbbULL, 0x52036cee2b6ffULL}};
+// sqrt(-1) = 2^((p-1)/4) mod p.
+const fe FE_SQRTM1 = {{0x61b274a0ea0b0ULL, 0xd5a5fc8f189dULL,
+                       0x7ef5e9cbd0c60ULL, 0x78595a6804c9eULL,
+                       0x2b8324804fc1dULL}};
+
+inline void fe_frombytes(fe &h, const uint8_t s[32]) {
+    // 255 bits little-endian, bit 255 masked; value may be >= p (lazy).
+    u64 w0, w1, w2, w3;
+    memcpy(&w0, s, 8);
+    memcpy(&w1, s + 8, 8);
+    memcpy(&w2, s + 16, 8);
+    memcpy(&w3, s + 24, 8);
+    h.v[0] = w0 & MASK51;
+    h.v[1] = ((w0 >> 51) | (w1 << 13)) & MASK51;
+    h.v[2] = ((w1 >> 38) | (w2 << 26)) & MASK51;
+    h.v[3] = ((w2 >> 25) | (w3 << 39)) & MASK51;
+    h.v[4] = (w3 >> 12) & MASK51;
+}
+
+inline void fe_carry(fe &h) {
+    for (int pass = 0; pass < 2; pass++) {
+        u64 c;
+        c = h.v[0] >> 51; h.v[0] &= MASK51; h.v[1] += c;
+        c = h.v[1] >> 51; h.v[1] &= MASK51; h.v[2] += c;
+        c = h.v[2] >> 51; h.v[2] &= MASK51; h.v[3] += c;
+        c = h.v[3] >> 51; h.v[3] &= MASK51; h.v[4] += c;
+        c = h.v[4] >> 51; h.v[4] &= MASK51; h.v[0] += c * 19;
+    }
+}
+
+inline void fe_tobytes(uint8_t s[32], const fe &f) {
+    // Canonical (fully reduced) little-endian encoding.
+    fe h = f;
+    fe_carry(h);
+    // freeze: add 19, propagate, then subtract 2^255 (drop top), giving
+    // h - p if h >= p else h  (standard trick: compute h + 19, if that
+    // overflows 255 bits the value was >= p).
+    u64 q = (h.v[0] + 19) >> 51;
+    q = (h.v[1] + q) >> 51;
+    q = (h.v[2] + q) >> 51;
+    q = (h.v[3] + q) >> 51;
+    q = (h.v[4] + q) >> 51;
+    h.v[0] += 19 * q;
+    u64 c;
+    c = h.v[0] >> 51; h.v[0] &= MASK51; h.v[1] += c;
+    c = h.v[1] >> 51; h.v[1] &= MASK51; h.v[2] += c;
+    c = h.v[2] >> 51; h.v[2] &= MASK51; h.v[3] += c;
+    c = h.v[3] >> 51; h.v[3] &= MASK51; h.v[4] += c;
+    h.v[4] &= MASK51;
+    u64 w0 = h.v[0] | (h.v[1] << 51);
+    u64 w1 = (h.v[1] >> 13) | (h.v[2] << 38);
+    u64 w2 = (h.v[2] >> 26) | (h.v[3] << 25);
+    u64 w3 = (h.v[3] >> 39) | (h.v[4] << 12);
+    memcpy(s, &w0, 8);
+    memcpy(s + 8, &w1, 8);
+    memcpy(s + 16, &w2, 8);
+    memcpy(s + 24, &w3, 8);
+}
+
+inline void fe_add(fe &h, const fe &f, const fe &g) {
+    for (int i = 0; i < 5; i++) h.v[i] = f.v[i] + g.v[i];
+    fe_carry(h);
+}
+
+inline void fe_sub(fe &h, const fe &f, const fe &g) {
+    // f + 2p - g keeps limbs nonnegative (inputs carried: limbs < 2^52).
+    h.v[0] = f.v[0] + 0xFFFFFFFFFFFDAULL * 2 - g.v[0];
+    h.v[1] = f.v[1] + 0xFFFFFFFFFFFFEULL * 2 - g.v[1];
+    h.v[2] = f.v[2] + 0xFFFFFFFFFFFFEULL * 2 - g.v[2];
+    h.v[3] = f.v[3] + 0xFFFFFFFFFFFFEULL * 2 - g.v[3];
+    h.v[4] = f.v[4] + 0xFFFFFFFFFFFFEULL * 2 - g.v[4];
+    fe_carry(h);
+}
+
+inline void fe_mul(fe &h, const fe &f, const fe &g) {
+    u128 r0 = (u128)f.v[0] * g.v[0] + (u128)(19 * f.v[1]) * g.v[4] +
+              (u128)(19 * f.v[2]) * g.v[3] + (u128)(19 * f.v[3]) * g.v[2] +
+              (u128)(19 * f.v[4]) * g.v[1];
+    u128 r1 = (u128)f.v[0] * g.v[1] + (u128)f.v[1] * g.v[0] +
+              (u128)(19 * f.v[2]) * g.v[4] + (u128)(19 * f.v[3]) * g.v[3] +
+              (u128)(19 * f.v[4]) * g.v[2];
+    u128 r2 = (u128)f.v[0] * g.v[2] + (u128)f.v[1] * g.v[1] +
+              (u128)f.v[2] * g.v[0] + (u128)(19 * f.v[3]) * g.v[4] +
+              (u128)(19 * f.v[4]) * g.v[3];
+    u128 r3 = (u128)f.v[0] * g.v[3] + (u128)f.v[1] * g.v[2] +
+              (u128)f.v[2] * g.v[1] + (u128)f.v[3] * g.v[0] +
+              (u128)(19 * f.v[4]) * g.v[4];
+    u128 r4 = (u128)f.v[0] * g.v[4] + (u128)f.v[1] * g.v[3] +
+              (u128)f.v[2] * g.v[2] + (u128)f.v[3] * g.v[1] +
+              (u128)f.v[4] * g.v[0];
+    u64 c;
+    c = (u64)(r0 >> 51); u64 h0 = (u64)r0 & MASK51; r1 += c;
+    c = (u64)(r1 >> 51); u64 h1 = (u64)r1 & MASK51; r2 += c;
+    c = (u64)(r2 >> 51); u64 h2 = (u64)r2 & MASK51; r3 += c;
+    c = (u64)(r3 >> 51); u64 h3 = (u64)r3 & MASK51; r4 += c;
+    c = (u64)(r4 >> 51); u64 h4 = (u64)r4 & MASK51;
+    h0 += c * 19;
+    c = h0 >> 51; h0 &= MASK51; h1 += c;
+    h.v[0] = h0; h.v[1] = h1; h.v[2] = h2; h.v[3] = h3; h.v[4] = h4;
+}
+
+inline void fe_sq(fe &h, const fe &f) { fe_mul(h, f, f); }
+
+inline void fe_one(fe &h) { h.v[0] = 1; h.v[1] = h.v[2] = h.v[3] = h.v[4] = 0; }
+
+// z^((p-5)/8): square-and-multiply over the fixed exponent
+// (p-5)/8 = 2^252 - 3 = 0b111...1101 (250 ones, 0, 1).
+inline void fe_pow22523(fe &out, const fe &z) {
+    // 2^252 - 3 = sum_{i=2}^{251} 2^i + 1  -> MSB-first bits:
+    // 250 ones, then 0, then 1.
+    fe r;
+    fe_one(r);
+    for (int i = 0; i < 250; i++) {  // leading 250 one-bits
+        fe_sq(r, r);
+        fe_mul(r, r, z);
+    }
+    fe_sq(r, r);             // bit 1 (zero)
+    fe_sq(r, r);             // bit 0 (one)
+    fe_mul(r, r, z);
+    out = r;
+}
+
+inline bool fe_eq(const fe &a, const fe &b) {
+    uint8_t sa[32], sb[32];
+    fe_tobytes(sa, a);
+    fe_tobytes(sb, b);
+    return memcmp(sa, sb, 32) == 0;
+}
+
+inline bool fe_iszero(const fe &a) {
+    uint8_t s[32];
+    fe_tobytes(s, a);
+    for (int i = 0; i < 32; i++)
+        if (s[i]) return false;
+    return true;
+}
+
+inline void fe_neg(fe &h, const fe &f) {
+    fe zero;
+    zero.v[0] = zero.v[1] = zero.v[2] = zero.v[3] = zero.v[4] = 0;
+    fe_sub(h, zero, f);
+}
+
+inline bool fe_isnegative(const fe &f) {
+    uint8_t s[32];
+    fe_tobytes(s, f);
+    return s[0] & 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched ZIP215 decompression.
+//   encodings: n * 32 bytes
+//   out:       n * 128 bytes — X ‖ Y ‖ Z ‖ T, each a canonical 32-byte
+//              little-endian field encoding (Z = 1)
+//   ok:        n bytes — 1 if the encoding decompressed, else 0
+void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
+                             uint8_t *out, uint8_t *ok) {
+    for (uint64_t i = 0; i < n; i++) {
+        const uint8_t *enc = encodings + 32 * i;
+        uint8_t *o = out + 128 * i;
+        int sign = enc[31] >> 7;
+
+        fe y, yy, u, v, v3, v7, r, chk, one;
+        fe_frombytes(y, enc);      // non-canonical y accepted (ZIP215)
+        fe_one(one);
+        fe_sq(yy, y);
+        fe_sub(u, yy, one);        // u = y^2 - 1
+        fe_mul(v, yy, FE_D);
+        fe_add(v, v, one);         // v = d y^2 + 1
+
+        // r = u v^3 (u v^7)^((p-5)/8)
+        fe_sq(v3, v);
+        fe_mul(v3, v3, v);
+        fe_sq(v7, v3);
+        fe_mul(v7, v7, v);
+        fe t0, t1;
+        fe_mul(t0, u, v7);
+        fe_pow22523(t1, t0);
+        fe_mul(r, u, v3);
+        fe_mul(r, r, t1);
+
+        fe_sq(chk, r);
+        fe_mul(chk, chk, v);       // chk = v r^2, should be ±u
+        bool good;
+        if (fe_eq(chk, u)) {
+            good = true;
+        } else {
+            fe mu;
+            fe_neg(mu, u);
+            if (fe_eq(chk, mu)) {
+                fe_mul(r, r, FE_SQRTM1);
+                good = true;
+            } else {
+                good = fe_iszero(u);  // u == 0 ⇒ x = 0 (r is 0 already)
+            }
+        }
+        if (!good) {
+            ok[i] = 0;
+            memset(o, 0, 128);
+            continue;
+        }
+        if (fe_isnegative(r)) fe_neg(r, r);  // choose the even root
+        if (sign) fe_neg(r, r);              // apply the sign bit (x=0 ok)
+
+        fe t;
+        fe_mul(t, r, y);
+        fe_tobytes(o, r);
+        fe_tobytes(o + 32, y);
+        fe_tobytes(o + 64, one);
+        fe_tobytes(o + 96, t);
+        ok[i] = 1;
+    }
+}
+
+}  // extern "C"
