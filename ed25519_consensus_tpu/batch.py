@@ -147,7 +147,7 @@ class Verifier:
 
     # -- verification ------------------------------------------------------
 
-    def verify(self, rng=None, backend: str = "host") -> None:
+    def verify(self, rng=None, backend: str = "host", metrics=None) -> None:
         """Verify all queued signatures; raises InvalidSignature unless ALL
         are valid (reference src/batch.rs:149-217).
 
@@ -161,10 +161,26 @@ class Verifier:
 
         All three are verdict-equivalent by construction — the
         exact-arithmetic parity is pinned by tests/test_device_parity.py
-        and tests/test_sharding.py."""
-        scalars, points = self._stage(rng)
+        and tests/test_sharding.py.
+
+        `metrics`, if given a `utils.metrics.BatchMetrics`, is filled with
+        batch size, coalescing ratio, and per-stage wall times."""
+        import time as _time
+
+        from .utils.metrics import BatchMetrics
+
+        if metrics is None:
+            metrics = BatchMetrics()
+        t_start = _time.perf_counter()
+        metrics.backend = backend
+        metrics.batch_size = self.batch_size
+        metrics.distinct_keys = len(self.signatures)
+        with metrics.stage("stage_host"):
+            scalars, points = self._stage(rng)
+        metrics.msm_terms = len(scalars)
         if backend == "host":
-            check = edwards.multiscalar_mul(scalars, points)
+            with metrics.stage("msm"):
+                check = edwards.multiscalar_mul(scalars, points)
         elif backend == "device":
             try:
                 from .ops import msm
@@ -172,7 +188,8 @@ class Verifier:
                 raise NotImplementedError(
                     "device MSM backend unavailable: " + str(e)
                 ) from e
-            check = msm.device_msm(scalars, points)
+            with metrics.stage("msm"):
+                check = msm.device_msm(scalars, points)
         elif backend == "sharded":
             try:
                 from .parallel import sharded_msm
@@ -180,11 +197,15 @@ class Verifier:
                 raise NotImplementedError(
                     "sharded MSM backend unavailable: " + str(e)
                 ) from e
-            check = sharded_msm.sharded_device_msm(scalars, points)
+            with metrics.stage("msm"):
+                check = sharded_msm.sharded_device_msm(scalars, points)
         else:
             raise ValueError(f"unknown backend {backend!r}")
         # Final cofactored identity check: host-exact, always.
-        if not check.mul_by_cofactor().is_identity():
+        with metrics.stage("cofactor_check"):
+            ok = check.mul_by_cofactor().is_identity()
+        metrics.total_seconds = _time.perf_counter() - t_start
+        if not ok:
             raise InvalidSignature()
 
     def verify_tpu(self, rng=None) -> None:

@@ -1,0 +1,58 @@
+"""Lightweight observability for the batch pipeline (SURVEY.md §5: the
+reference has none; the TPU build adds counters for sigs/sec, batch size,
+coalescing ratio m/n, and per-stage wall times)."""
+
+import time
+from contextlib import contextmanager
+
+
+class BatchMetrics:
+    """Per-verify() metrics, filled by Verifier.verify(metrics=...)."""
+
+    def __init__(self):
+        self.batch_size = 0
+        self.distinct_keys = 0
+        self.msm_terms = 0
+        self.backend = None
+        self.stage_seconds = {}
+        self.total_seconds = 0.0
+
+    @property
+    def coalescing_ratio(self) -> float:
+        """m/n — 1.0 means no coalescing benefit, →0 means maximal."""
+        if not self.batch_size:
+            return 1.0
+        return self.distinct_keys / self.batch_size
+
+    @property
+    def sigs_per_sec(self) -> float:
+        if not self.total_seconds:
+            return 0.0
+        return self.batch_size / self.total_seconds
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stage_seconds[name] = (
+                self.stage_seconds.get(name, 0.0) + time.perf_counter() - t0
+            )
+
+    def as_dict(self) -> dict:
+        return {
+            "batch_size": self.batch_size,
+            "distinct_keys": self.distinct_keys,
+            "msm_terms": self.msm_terms,
+            "backend": self.backend,
+            "coalescing_ratio": round(self.coalescing_ratio, 4),
+            "sigs_per_sec": round(self.sigs_per_sec, 1),
+            "stage_seconds": {
+                k: round(v, 6) for k, v in self.stage_seconds.items()
+            },
+            "total_seconds": round(self.total_seconds, 6),
+        }
+
+    def __repr__(self):
+        return f"BatchMetrics({self.as_dict()})"

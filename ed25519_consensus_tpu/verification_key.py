@@ -88,7 +88,9 @@ class VerificationKey:
             vkb = data
         else:
             vkb = VerificationKeyBytes(data)
-        A = edwards.decompress(vkb.to_bytes())
+        from . import native
+
+        A = native.decompress_batch([vkb.to_bytes()])[0]
         if A is None:
             raise MalformedPublicKey()
         return cls(vkb, A.neg())
@@ -132,10 +134,12 @@ class VerificationKey:
         * [8](R - ([s]B - [k]A)) MUST be the identity — the cofactored
           equation; the cofactorless variant MUST NOT be used.
         """
+        from . import native
+
         s = scalar.from_canonical_bytes(signature.s_bytes)
         if s is None:
             raise InvalidSignature()
-        R = edwards.decompress(signature.R_bytes)
+        R = native.decompress_batch([signature.R_bytes])[0]
         if R is None:
             raise InvalidSignature()
         # R' = [s]B - [k]A computed as [k](-A) + [s]B
