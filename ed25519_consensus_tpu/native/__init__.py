@@ -51,6 +51,15 @@ def load():
             ctypes.c_char_p,
         ]
         lib.zip215_decompress_batch.restype = None
+        lib.edwards_vartime_msm.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        lib.edwards_vartime_msm.restype = None
+        lib.zip215_check_prehashed.argtypes = [ctypes.c_char_p] * 5
+        lib.zip215_check_prehashed.restype = ctypes.c_int
         _self_check(lib)
         _lib = lib
     except Exception:
@@ -75,6 +84,10 @@ def _self_check(lib):
             raise RuntimeError("native decompress disagreement")
         if pt is not None and pt != want:
             raise RuntimeError("native decompress disagreement")
+    B = edwards.BASEPOINT
+    got_msm = _vartime_msm_raw(lib, [2, 3], [B, B])
+    if got_msm != B.scalar_mul(5):
+        raise RuntimeError("native msm disagreement")
 
 
 def _decompress_batch_raw(lib, encodings):
@@ -113,3 +126,61 @@ def decompress_batch(encodings):
     from ..ops import edwards
 
     return [edwards.decompress(e) for e in encodings]
+
+
+def _point128(pt) -> bytes:
+    from ..ops.field import P
+
+    return b"".join(
+        (c % P).to_bytes(32, "little") for c in (pt.X, pt.Y, pt.Z, pt.T)
+    )
+
+
+def _vartime_msm_raw(lib, scalars, points):
+    from ..ops.edwards import Point
+
+    n = len(scalars)
+    sblob = b"".join(int(s).to_bytes(32, "little") for s in scalars)
+    pblob = b"".join(_point128(p) for p in points)
+    out = ctypes.create_string_buffer(128)
+    lib.edwards_vartime_msm(sblob, pblob, n, out)
+    o = out.raw
+    return Point(
+        int.from_bytes(o[0:32], "little"),
+        int.from_bytes(o[32:64], "little"),
+        int.from_bytes(o[64:96], "little"),
+        int.from_bytes(o[96:128], "little"),
+    )
+
+
+def vartime_msm(scalars, points):
+    """Native Σ[c_i]P_i (scalars < 2^256, verification-grade vartime);
+    exact-Python fallback.  The host-backend MSM of batch.Verifier."""
+    lib = load()
+    if lib is not None:
+        return _vartime_msm_raw(lib, scalars, points)
+    from ..ops import edwards
+
+    return edwards.multiscalar_mul(scalars, points)
+
+
+def check_prehashed(A, R, k: int, s: int) -> bool:
+    """Native ZIP215 cofactored equation check
+    [8](R - ([s]B - [k]A)) == identity with decompressed A, R.
+    Canonicality of s and all decompression decisions remain the caller's
+    (host Python) responsibility.  Exact-Python fallback."""
+    from ..ops import edwards
+
+    lib = load()
+    if lib is None:
+        R_prime = edwards.double_scalar_mul_basepoint(k, A.neg(), s)
+        return (R - R_prime).mul_by_cofactor().is_identity()
+    return bool(
+        lib.zip215_check_prehashed(
+            _point128(A),
+            _point128(R),
+            _point128(edwards.BASEPOINT),
+            int(k).to_bytes(32, "little"),
+            int(s).to_bytes(32, "little"),
+        )
+    )

@@ -178,9 +178,157 @@ inline bool fe_isnegative(const fe &f) {
     return s[0] & 1;
 }
 
+// ---- Edwards group ops (extended coordinates, complete addition) --------
+
+struct ge {
+    fe X, Y, Z, T;
+};
+
+inline void ge_frombytes128(ge &p, const uint8_t *b) {
+    fe_frombytes(p.X, b);
+    fe_frombytes(p.Y, b + 32);
+    fe_frombytes(p.Z, b + 64);
+    fe_frombytes(p.T, b + 96);
+}
+
+inline void ge_tobytes128(uint8_t *b, const ge &p) {
+    fe_tobytes(b, p.X);
+    fe_tobytes(b + 32, p.Y);
+    fe_tobytes(b + 64, p.Z);
+    fe_tobytes(b + 96, p.T);
+}
+
+inline void ge_identity(ge &p) {
+    fe_one(p.Y);
+    fe_one(p.Z);
+    p.X.v[0] = p.X.v[1] = p.X.v[2] = p.X.v[3] = p.X.v[4] = 0;
+    p.T = p.X;
+}
+
+// Complete unified addition (add-2008-hwcd-3, a=-1, k=2d) — same formula
+// as the Python/JAX paths, valid for all inputs including torsion.
+inline void ge_add(ge &r, const ge &p, const ge &q) {
+    fe d2;
+    fe_add(d2, FE_D, FE_D);
+    fe a, b, c, d, e, f, g, h, t0, t1;
+    fe_sub(t0, p.Y, p.X);
+    fe_sub(t1, q.Y, q.X);
+    fe_mul(a, t0, t1);
+    fe_add(t0, p.Y, p.X);
+    fe_add(t1, q.Y, q.X);
+    fe_mul(b, t0, t1);
+    fe_mul(c, p.T, d2);
+    fe_mul(c, c, q.T);
+    fe_mul(d, p.Z, q.Z);
+    fe_add(d, d, d);
+    fe_sub(e, b, a);
+    fe_sub(f, d, c);
+    fe_add(g, d, c);
+    fe_add(h, b, a);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.Z, f, g);
+    fe_mul(r.T, e, h);
+}
+
+inline void ge_double(ge &r, const ge &p) {
+    // dbl-2008-hwcd with a=-1 (agrees with ge_add(p,p)).
+    fe a, b, c, e, f, g, h, s;
+    fe_sq(a, p.X);
+    fe_sq(b, p.Y);
+    fe_sq(c, p.Z);
+    fe_add(c, c, c);
+    fe_add(s, p.X, p.Y);
+    fe_sq(e, s);
+    fe_sub(e, e, a);
+    fe_sub(e, e, b);
+    fe_sub(g, b, a);
+    fe_sub(f, g, c);
+    fe_add(h, a, b);
+    fe_neg(h, h);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.Z, f, g);
+    fe_mul(r.T, e, h);
+}
+
 }  // namespace
 
 extern "C" {
+
+// Variable-time multiscalar multiplication: out = Σ [scalar_i] P_i.
+// Straus with shared doublings and per-point radix-16 tables — the native
+// analog of the MSM the reference takes from dalek (reference
+// src/batch.rs:207-210).  Verification only: inputs are public, so
+// variable time is fine.
+//   scalars: n * 32 bytes, little-endian integers < 2^256
+//   points:  n * 128 bytes (X‖Y‖Z‖T canonical encodings)
+//   out:     128 bytes
+void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
+                         uint64_t n, uint8_t *out) {
+    ge acc;
+    ge_identity(acc);
+    if (n > 0) {
+        // per-point tables: T[i][j] = [j] P_i, j = 0..15
+        ge *tables = new ge[n * 16];
+        for (uint64_t i = 0; i < n; i++) {
+            ge p;
+            ge_frombytes128(p, points + 128 * i);
+            ge_identity(tables[16 * i]);
+            tables[16 * i + 1] = p;
+            for (int j = 2; j < 16; j++)
+                ge_add(tables[16 * i + j], tables[16 * i + j - 1], p);
+        }
+        for (int w = 63; w >= 0; w--) {
+            if (w != 63)
+                for (int k = 0; k < 4; k++) ge_double(acc, acc);
+            int byte = w / 2, shift = (w & 1) ? 4 : 0;
+            for (uint64_t i = 0; i < n; i++) {
+                int digit = (scalars[32 * i + byte] >> shift) & 15;
+                if (digit) ge_add(acc, acc, tables[16 * i + digit]);
+            }
+        }
+        delete[] tables;
+    }
+    ge_tobytes128(out, acc);
+}
+
+// Full ZIP215 prehashed verification check:
+//   ok = [8]( R - ([s]B - [k]A) ) == identity
+// with A, R, B given decompressed (128-byte extended form), k and s as
+// 32-byte little-endian scalars (already reduced / validated by the host).
+// The caller (Python) remains responsible for the s < ℓ canonicality
+// rejection and the decompression accept/reject decisions.
+int zip215_check_prehashed(const uint8_t *A128, const uint8_t *R128,
+                           const uint8_t *B128, const uint8_t *k32,
+                           const uint8_t *s32) {
+    // check = [k](-A) + [s]B + (-R'?) — compute [k](-A) + [s]B, subtract
+    // from R, multiply by cofactor, test identity.
+    ge A, R, B;
+    ge_frombytes128(A, A128);
+    ge_frombytes128(R, R128);
+    ge_frombytes128(B, B128);
+    // minus_A
+    fe_neg(A.X, A.X);
+    fe_neg(A.T, A.T);
+    uint8_t scalars[64], pts[256], rprime[128];
+    memcpy(scalars, k32, 32);
+    memcpy(scalars + 32, s32, 32);
+    ge_tobytes128(pts, A);
+    memcpy(pts + 128, B128, 128);
+    edwards_vartime_msm(scalars, pts, 2, rprime);
+    ge Rp, diff;
+    ge_frombytes128(Rp, rprime);
+    // diff = R - R'
+    fe_neg(Rp.X, Rp.X);
+    fe_neg(Rp.T, Rp.T);
+    ge_add(diff, R, Rp);
+    ge_double(diff, diff);
+    ge_double(diff, diff);
+    ge_double(diff, diff);
+    // identity ⇔ X == 0 and Y == Z
+    return (fe_iszero(diff.X) && fe_eq(diff.Y, diff.Z)) ? 1 : 0;
+}
 
 // Batched ZIP215 decompression.
 //   encodings: n * 32 bytes

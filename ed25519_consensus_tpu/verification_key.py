@@ -142,7 +142,7 @@ class VerificationKey:
         R = native.decompress_batch([signature.R_bytes])[0]
         if R is None:
             raise InvalidSignature()
-        # R' = [s]B - [k]A computed as [k](-A) + [s]B
-        R_prime = edwards.double_scalar_mul_basepoint(k, self.minus_A, s)
-        if not (R - R_prime).mul_by_cofactor().is_identity():
+        # [8](R - ([s]B - [k]A)) == identity; native fast path with exact
+        # Python fallback — both compute the identical group equation.
+        if not native.check_prehashed(self.minus_A.neg(), R, k, s):
             raise InvalidSignature()
