@@ -24,15 +24,35 @@ _lib = None
 _lib_failed = False
 
 
+_CXXFLAGS = ["-O3", "-march=native", "-shared", "-fPIC"]
+_STAMP = _SO + ".stamp"
+
+
+def _stamp_value() -> str:
+    # -march=native makes the binary machine-specific: key the cache on the
+    # flags, the source mtime, AND the host, so a checkout moved between
+    # machines (or a flags change) rebuilds instead of loading a stale .so
+    # that could die with SIGILL mid-verification.
+    return "|".join(
+        [" ".join(_CXXFLAGS), str(os.path.getmtime(_SRC)), os.uname().machine,
+         os.uname().nodename]
+    )
+
+
 def _build() -> str:
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-        _SRC
-    ):
+    stamp = _stamp_value()
+    have = None
+    if os.path.exists(_SO) and os.path.exists(_STAMP):
+        with open(_STAMP) as f:
+            have = f.read()
+    if have != stamp:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            ["g++", *_CXXFLAGS, "-o", _SO, _SRC],
             check=True,
             capture_output=True,
         )
+        with open(_STAMP, "w") as f:
+            f.write(stamp)
     return _SO
 
 
@@ -88,6 +108,48 @@ def _self_check(lib):
     got_msm = _vartime_msm_raw(lib, [2, 3], [B, B])
     if got_msm != B.scalar_mul(5):
         raise RuntimeError("native msm disagreement")
+    # Full-width scalars exercise every radix-16 window of the native
+    # Straus loop (not just the low byte), plus a torsion point.
+    from ..ops import scalar
+
+    a = (1 << 252) + 0x123456789ABCDEF_FEDCBA987654321
+    b = scalar.L - 2
+    T8 = edwards.eight_torsion()[1]
+    got_msm = _vartime_msm_raw(lib, [a, b], [B, T8])
+    if got_msm != B.scalar_mul(a).add(T8.scalar_mul(b)):
+        raise RuntimeError("native msm disagreement (wide)")
+    # check_prehashed: a real signature must pass, a tampered k must fail.
+    from ..signing_key import SigningKey
+
+    sk = SigningKey.from_bytes(bytes(range(32)))
+    sig = sk.sign(b"native self check")
+    vk = sk.verification_key()
+    import hashlib
+
+    h = hashlib.sha512()
+    h.update(sig.R_bytes)
+    h.update(vk.A_bytes.to_bytes())
+    h.update(b"native self check")
+    k = scalar.from_hash(h)
+    s = scalar.from_canonical_bytes(sig.s_bytes)
+    R = edwards.decompress(sig.R_bytes)
+    ok = bool(
+        lib.zip215_check_prehashed(
+            _point128(vk.minus_A), _point128(R),
+            _point128(edwards.BASEPOINT),
+            int(k).to_bytes(32, "little"), int(s).to_bytes(32, "little"),
+        )
+    )
+    bad = bool(
+        lib.zip215_check_prehashed(
+            _point128(vk.minus_A), _point128(R),
+            _point128(edwards.BASEPOINT),
+            int(scalar.add(k, 1)).to_bytes(32, "little"),
+            int(s).to_bytes(32, "little"),
+        )
+    )
+    if not ok or bad:
+        raise RuntimeError("native check_prehashed disagreement")
 
 
 def _decompress_batch_raw(lib, encodings):
@@ -164,20 +226,21 @@ def vartime_msm(scalars, points):
     return edwards.multiscalar_mul(scalars, points)
 
 
-def check_prehashed(A, R, k: int, s: int) -> bool:
+def check_prehashed(minus_A, R, k: int, s: int) -> bool:
     """Native ZIP215 cofactored equation check
-    [8](R - ([s]B - [k]A)) == identity with decompressed A, R.
+    [8](R - ([s]B - [k]A)) == identity, taking the key's cached −A directly
+    (reference src/verification_key.rs:111-114 caches −A for this path).
     Canonicality of s and all decompression decisions remain the caller's
     (host Python) responsibility.  Exact-Python fallback."""
     from ..ops import edwards
 
     lib = load()
     if lib is None:
-        R_prime = edwards.double_scalar_mul_basepoint(k, A.neg(), s)
+        R_prime = edwards.double_scalar_mul_basepoint(k, minus_A, s)
         return (R - R_prime).mul_by_cofactor().is_identity()
     return bool(
         lib.zip215_check_prehashed(
-            _point128(A),
+            _point128(minus_A),
             _point128(R),
             _point128(edwards.BASEPOINT),
             int(k).to_bytes(32, "little"),

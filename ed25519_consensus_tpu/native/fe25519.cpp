@@ -29,6 +29,10 @@ struct fe {
 // d = -121665/121666 mod p, radix-2^51 limbs (little-endian limb order).
 const fe FE_D = {{0x34dca135978a3ULL, 0x1a8283b156ebdULL, 0x5e7a26001c029ULL,
                   0x739c663a03cbbULL, 0x52036cee2b6ffULL}};
+// 2d mod p — the k=2d constant of the unified addition formula.
+const fe FE_2D = {{0x69b9426b2f159ULL, 0x35050762add7aULL,
+                   0x3cf44c0038052ULL, 0x6738cc7407977ULL,
+                   0x2406d9dc56dffULL}};
 // sqrt(-1) = 2^((p-1)/4) mod p.
 const fe FE_SQRTM1 = {{0x61b274a0ea0b0ULL, 0xd5a5fc8f189dULL,
                        0x7ef5e9cbd0c60ULL, 0x78595a6804c9eULL,
@@ -134,21 +138,38 @@ inline void fe_sq(fe &h, const fe &f) { fe_mul(h, f, f); }
 
 inline void fe_one(fe &h) { h.v[0] = 1; h.v[1] = h.v[2] = h.v[3] = h.v[4] = 0; }
 
-// z^((p-5)/8): square-and-multiply over the fixed exponent
-// (p-5)/8 = 2^252 - 3 = 0b111...1101 (250 ones, 0, 1).
+// z^((p-5)/8) with (p-5)/8 = 2^252 - 3, via the standard 2^k-1 ladder
+// addition chain: 252 squarings + 12 multiplications (vs ~503 ops for
+// naive square-and-multiply over the 250 one-bits).
 inline void fe_pow22523(fe &out, const fe &z) {
-    // 2^252 - 3 = sum_{i=2}^{251} 2^i + 1  -> MSB-first bits:
-    // 250 ones, then 0, then 1.
-    fe r;
-    fe_one(r);
-    for (int i = 0; i < 250; i++) {  // leading 250 one-bits
-        fe_sq(r, r);
-        fe_mul(r, r, z);
-    }
-    fe_sq(r, r);             // bit 1 (zero)
-    fe_sq(r, r);             // bit 0 (one)
-    fe_mul(r, r, z);
-    out = r;
+    fe t0, t1, t2;
+    fe_sq(t0, z);                                        // z^2
+    fe_sq(t1, t0); fe_sq(t1, t1);                        // z^8
+    fe_mul(t1, t1, z);                                   // z^9
+    fe_mul(t0, t0, t1);                                  // z^11
+    fe_sq(t0, t0);                                       // z^22
+    fe_mul(t0, t1, t0);                                  // z^(2^5-1)
+    fe_sq(t1, t0);
+    for (int i = 1; i < 5; i++) fe_sq(t1, t1);           // z^(2^10-2^5)
+    fe_mul(t0, t1, t0);                                  // z^(2^10-1)
+    fe_sq(t1, t0);
+    for (int i = 1; i < 10; i++) fe_sq(t1, t1);          // z^(2^20-2^10)
+    fe_mul(t1, t1, t0);                                  // z^(2^20-1)
+    fe_sq(t2, t1);
+    for (int i = 1; i < 20; i++) fe_sq(t2, t2);          // z^(2^40-2^20)
+    fe_mul(t1, t2, t1);                                  // z^(2^40-1)
+    for (int i = 0; i < 10; i++) fe_sq(t1, t1);          // z^(2^50-2^10)
+    fe_mul(t0, t1, t0);                                  // z^(2^50-1)
+    fe_sq(t1, t0);
+    for (int i = 1; i < 50; i++) fe_sq(t1, t1);          // z^(2^100-2^50)
+    fe_mul(t1, t1, t0);                                  // z^(2^100-1)
+    fe_sq(t2, t1);
+    for (int i = 1; i < 100; i++) fe_sq(t2, t2);         // z^(2^200-2^100)
+    fe_mul(t1, t2, t1);                                  // z^(2^200-1)
+    for (int i = 0; i < 50; i++) fe_sq(t1, t1);          // z^(2^250-2^50)
+    fe_mul(t0, t1, t0);                                  // z^(2^250-1)
+    fe_sq(t0, t0); fe_sq(t0, t0);                        // z^(2^252-4)
+    fe_mul(out, t0, z);                                  // z^(2^252-3)
 }
 
 inline bool fe_eq(const fe &a, const fe &b) {
@@ -208,8 +229,6 @@ inline void ge_identity(ge &p) {
 // Complete unified addition (add-2008-hwcd-3, a=-1, k=2d) — same formula
 // as the Python/JAX paths, valid for all inputs including torsion.
 inline void ge_add(ge &r, const ge &p, const ge &q) {
-    fe d2;
-    fe_add(d2, FE_D, FE_D);
     fe a, b, c, d, e, f, g, h, t0, t1;
     fe_sub(t0, p.Y, p.X);
     fe_sub(t1, q.Y, q.X);
@@ -217,7 +236,7 @@ inline void ge_add(ge &r, const ge &p, const ge &q) {
     fe_add(t0, p.Y, p.X);
     fe_add(t1, q.Y, q.X);
     fe_mul(b, t0, t1);
-    fe_mul(c, p.T, d2);
+    fe_mul(c, p.T, FE_2D);
     fe_mul(c, c, q.T);
     fe_mul(d, p.Z, q.Z);
     fe_add(d, d, d);
@@ -295,26 +314,21 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
 
 // Full ZIP215 prehashed verification check:
 //   ok = [8]( R - ([s]B - [k]A) ) == identity
-// with A, R, B given decompressed (128-byte extended form), k and s as
-// 32-byte little-endian scalars (already reduced / validated by the host).
-// The caller (Python) remains responsible for the s < ℓ canonicality
-// rejection and the decompression accept/reject decisions.
-int zip215_check_prehashed(const uint8_t *A128, const uint8_t *R128,
+// with −A, R, B given decompressed (128-byte extended form; the key caches
+// −A precisely for this path, reference src/verification_key.rs:111-114),
+// k and s as 32-byte little-endian scalars (already reduced / validated by
+// the host).  The caller (Python) remains responsible for the s < ℓ
+// canonicality rejection and the decompression accept/reject decisions.
+int zip215_check_prehashed(const uint8_t *minusA128, const uint8_t *R128,
                            const uint8_t *B128, const uint8_t *k32,
                            const uint8_t *s32) {
-    // check = [k](-A) + [s]B + (-R'?) — compute [k](-A) + [s]B, subtract
-    // from R, multiply by cofactor, test identity.
-    ge A, R, B;
-    ge_frombytes128(A, A128);
+    // R' = [k](−A) + [s]B; then [8](R − R') == identity.
+    ge R;
     ge_frombytes128(R, R128);
-    ge_frombytes128(B, B128);
-    // minus_A
-    fe_neg(A.X, A.X);
-    fe_neg(A.T, A.T);
     uint8_t scalars[64], pts[256], rprime[128];
     memcpy(scalars, k32, 32);
     memcpy(scalars + 32, s32, 32);
-    ge_tobytes128(pts, A);
+    memcpy(pts, minusA128, 128);
     memcpy(pts + 128, B128, 128);
     edwards_vartime_msm(scalars, pts, 2, rprime);
     ge Rp, diff;

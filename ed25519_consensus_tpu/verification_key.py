@@ -144,5 +144,5 @@ class VerificationKey:
             raise InvalidSignature()
         # [8](R - ([s]B - [k]A)) == identity; native fast path with exact
         # Python fallback — both compute the identical group equation.
-        if not native.check_prehashed(self.minus_A.neg(), R, k, s):
+        if not native.check_prehashed(self.minus_A, R, k, s):
             raise InvalidSignature()

@@ -61,3 +61,62 @@ def test_batch_staging_through_native():
     bad.queue((sk.verification_key_bytes(), sk.sign(b"x"), b"y"))
     with pytest.raises(InvalidSignature):
         bad.verify(rng=rng)
+
+
+def test_native_msm_parity():
+    """vartime_msm must agree with the exact Python MSM on full-width
+    scalars, torsion points, identity terms, and varying sizes."""
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    tors = edwards.eight_torsion()
+    for n in (1, 2, 3, 17):
+        scalars = [rng.randrange(0, 1 << 256) for _ in range(n)]
+        points = [
+            edwards.BASEPOINT.scalar_mul(rng.randrange(1, L)).add(
+                tors[rng.randrange(8)]
+            )
+            for _ in range(n)
+        ]
+        # mix in degenerate terms
+        scalars[0] = 0
+        if n > 2:
+            points[2] = edwards.identity()
+        want = edwards.multiscalar_mul(scalars, points)
+        got = native.vartime_msm(scalars, points)
+        assert got == want
+
+
+def test_native_check_prehashed_parity():
+    """check_prehashed must match the exact Python cofactored equation on
+    valid, tampered, and small-order inputs."""
+    import hashlib
+
+    from ed25519_consensus_tpu.ops import scalar
+
+    sk = SigningKey.new(rng)
+    msg = b"check prehashed parity"
+    sig = sk.sign(msg)
+    vk = sk.verification_key()
+    h = hashlib.sha512()
+    h.update(sig.R_bytes)
+    h.update(vk.A_bytes.to_bytes())
+    h.update(msg)
+    k = scalar.from_hash(h)
+    s = scalar.from_canonical_bytes(sig.s_bytes)
+    R = edwards.decompress(sig.R_bytes)
+
+    def python_check(minus_A, R, k, s):
+        R_prime = edwards.double_scalar_mul_basepoint(k, minus_A, s)
+        return (R - R_prime).mul_by_cofactor().is_identity()
+
+    cases = [
+        (vk.minus_A, R, k, s),
+        (vk.minus_A, R, scalar.add(k, 1), s),  # tampered challenge
+        (vk.minus_A, R, k, scalar.add(s, 1)),  # tampered s
+        # small-order A and R with s = 0: ZIP215's divergence case
+        (edwards.eight_torsion()[1].neg(), edwards.eight_torsion()[2], 7, 0),
+    ]
+    for minus_A, Rc, kc, sc in cases:
+        assert native.check_prehashed(minus_A, Rc, kc, sc) == python_check(
+            minus_A, Rc, kc, sc
+        )
