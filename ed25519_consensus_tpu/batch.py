@@ -82,6 +82,24 @@ class Item:
         )
 
 
+# [2^128]A per verification key, for the device MSM's uniform-128-bit
+# scalar split (ops/msm.py).  Keyed by the 32-byte encoding; values are
+# deterministic exact host points, so the cache can never go stale.  In
+# consensus workloads the key set (validators) is small and recurring.
+_shift128_cache = {}
+_SHIFT_CACHE_MAX = 1 << 16
+
+
+def _shift128_for_key(vk_bytes: bytes, A) -> "object":
+    sp = _shift128_cache.get(vk_bytes)
+    if sp is None:
+        sp = edwards.shift128(A)
+        if len(_shift128_cache) >= _SHIFT_CACHE_MAX:
+            _shift128_cache.pop(next(iter(_shift128_cache)))
+        _shift128_cache[vk_bytes] = sp
+    return sp
+
+
 class Verifier:
     """A batch verification context (reference src/batch.rs:110-218)."""
 
@@ -105,8 +123,11 @@ class Verifier:
     def _stage(self, rng):
         """Host staging: decompress all points, enforce `s < ℓ`, sample
         blinders, coalesce per-key A coefficients.  Returns the flat MSM
-        term list (scalars, points).  Raises InvalidSignature on ANY
-        malformed input — before any device dispatch (all-or-nothing
+        term list plus the cached [2^128]·point shifts the device backend
+        uses for its 128-bit scalar split: (scalars, points, shifts), with
+        shifts[i] = None where no precomputed shift exists (R terms — their
+        blinders are < 2^128 and never split).  Raises InvalidSignature on
+        ANY malformed input — before any device dispatch (all-or-nothing
         semantics, reference src/batch.rs:139-147, 182-203)."""
         from . import native
 
@@ -121,7 +142,7 @@ class Verifier:
         R_points = iter(decompressed[len(groups) :])
 
         B_coeff = 0
-        A_coeffs, As = [], []
+        A_coeffs, As, A_shifts = [], [], []
         R_coeffs, Rs = [], []
         for (vk_bytes, sigs), A in zip(groups, A_points):
             if A is None:
@@ -140,10 +161,12 @@ class Verifier:
                 R_coeffs.append(scalar.reduce(z))
                 A_coeff = scalar.add(A_coeff, scalar.mul(z, k))
             As.append(A)
+            A_shifts.append(_shift128_for_key(vk_bytes.to_bytes(), A))
             A_coeffs.append(A_coeff)
         scalars = [B_coeff] + A_coeffs + R_coeffs
         points = [edwards.BASEPOINT] + As + Rs
-        return scalars, points
+        shifts = [edwards.basepoint_shift128()] + A_shifts + [None] * len(Rs)
+        return scalars, points, shifts
 
     # -- verification ------------------------------------------------------
 
@@ -176,7 +199,7 @@ class Verifier:
         metrics.batch_size = self.batch_size
         metrics.distinct_keys = len(self.signatures)
         with metrics.stage("stage_host"):
-            scalars, points = self._stage(rng)
+            scalars, points, shifts = self._stage(rng)
         metrics.msm_terms = len(scalars)
         if backend == "host":
             with metrics.stage("msm"):
@@ -191,7 +214,7 @@ class Verifier:
                     "device MSM backend unavailable: " + str(e)
                 ) from e
             with metrics.stage("msm"):
-                check = msm.device_msm(scalars, points)
+                check = msm.device_msm(scalars, points, shifts)
         elif backend == "sharded":
             try:
                 from .parallel import sharded_msm
@@ -200,7 +223,9 @@ class Verifier:
                     "sharded MSM backend unavailable: " + str(e)
                 ) from e
             with metrics.stage("msm"):
-                check = sharded_msm.sharded_device_msm(scalars, points)
+                check = sharded_msm.sharded_device_msm(
+                    scalars, points, shifts=shifts
+                )
         else:
             raise ValueError(f"unknown backend {backend!r}")
         # Final cofactored identity check: host-exact, always.
@@ -210,7 +235,41 @@ class Verifier:
         if not ok:
             raise InvalidSignature()
 
+    def verify_async(self, rng=None) -> "PendingVerification":
+        """Pipelined device verification: stage on the host, dispatch the
+        device MSM, and return immediately.  The returned handle's
+        `.result()` blocks on the device, finishes the exact host Horner
+        combine + cofactored identity check, and raises InvalidSignature on
+        a bad batch.  Many batches can be in flight at once — host staging
+        of batch i+1 overlaps device compute of batch i (SURVEY.md §2.3)."""
+        try:
+            from .ops import msm
+        except ImportError as e:
+            raise NotImplementedError(
+                "device MSM backend unavailable: " + str(e)
+            ) from e
+
+        scalars, points, shifts = self._stage(rng)
+        return PendingVerification(msm.device_msm_async(scalars, points, shifts))
+
     def verify_tpu(self, rng=None) -> None:
         """Convenience entry point for the device backend (the analog of the
         north-star `Verifier::verify_tpu()`)."""
         self.verify(rng=rng, backend="device")
+
+
+class PendingVerification:
+    """Handle for an in-flight device batch verification."""
+
+    __slots__ = ("_pending",)
+
+    def __init__(self, pending):
+        self._pending = pending
+
+    def result(self) -> None:
+        """Block until the device MSM lands; raises InvalidSignature unless
+        the whole batch is valid.  The Horner combine and the cofactored
+        identity check both run in exact host integers."""
+        check = self._pending.result()
+        if not check.mul_by_cofactor().is_identity():
+            raise InvalidSignature()

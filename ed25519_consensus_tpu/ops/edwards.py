@@ -223,6 +223,27 @@ def double_scalar_mul_basepoint(a: int, A: Point, b: int) -> Point:
     return A.scalar_mul(a).add(basepoint_mul(b))
 
 
+def shift128(p: Point) -> Point:
+    """[2^128]P by 128 exact doublings — the host-side half of the device
+    MSM's uniform-128-bit-scalar split (ops/msm.py): a ≥2^128 coefficient c
+    on P becomes c_lo on P plus c_hi on shift128(P).  batch.py caches the
+    result per verification key."""
+    for _ in range(128):
+        p = p.double()
+    return p
+
+
+_BASEPOINT_SHIFT128 = None
+
+
+def basepoint_shift128() -> Point:
+    """[2^128]B, precomputed once for the basepoint coefficient split."""
+    global _BASEPOINT_SHIFT128
+    if _BASEPOINT_SHIFT128 is None:
+        _BASEPOINT_SHIFT128 = shift128(BASEPOINT)
+    return _BASEPOINT_SHIFT128
+
+
 def multiscalar_mul(scalars, points) -> Point:
     """Σ [c_i]P_i — host MSM (dalek `VartimeMultiscalarMul`, reference
     src/batch.rs:207-210).  Straus with shared doublings and per-point 4-bit

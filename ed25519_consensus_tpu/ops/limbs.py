@@ -99,17 +99,21 @@ def pack_scalar_bits(scalars, nbits: int = SCALAR_BITS) -> np.ndarray:
 
 
 WINDOW_BITS = 4
-NWINDOWS = 64  # radix-16 windows covering 256 bits
+NWINDOWS = 32  # radix-16 windows covering the uniform 128-bit scalars
 
 
-def pack_scalar_windows(scalars) -> np.ndarray:
-    """Pack scalars (< 2^256) into MSB-first radix-16 digit planes
-    (NWINDOWS, N) int32 (vectorized via np.unpackbits)."""
-    bits = _ints_to_bits(scalars, 32)  # (N, 256) little-endian bits
+def pack_scalar_windows(scalars, nwindows: int = NWINDOWS) -> np.ndarray:
+    """Pack scalars (< 16^nwindows) into MSB-first radix-16 digit planes
+    (nwindows, N) int32 (vectorized via np.unpackbits)."""
+    nbytes = (nwindows * WINDOW_BITS + 7) // 8
+    for s in scalars:
+        if s >> (nwindows * WINDOW_BITS):
+            raise ValueError(f"scalar exceeds {nwindows} radix-16 windows")
+    bits = _ints_to_bits(scalars, nbytes)[:, : nwindows * WINDOW_BITS]
     w = (1 << np.arange(WINDOW_BITS, dtype=np.int32)).astype(np.int32)
-    digits = bits.reshape(len(scalars), NWINDOWS, WINDOW_BITS).astype(
+    digits = bits.reshape(len(scalars), nwindows, WINDOW_BITS).astype(
         np.int32
-    ) @ w  # (N, NWINDOWS) little-endian window order
+    ) @ w  # (N, nwindows) little-endian window order
     return digits[:, ::-1].T.copy()
 
 

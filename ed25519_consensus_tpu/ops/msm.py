@@ -1,51 +1,62 @@
 """Device multiscalar multiplication Σ[c_i]P_i — the batch-verification hot
 path (reference src/batch.rs:207-210), rebuilt TPU-first.
 
-Algorithm: **transposed windowed Straus**.  Writing each scalar in 64
-radix-16 windows c_i = Σ_w 16^(63-w)·d_{i,w}:
+Algorithm: **transposed windowed Straus over uniform 128-bit scalars**.
 
-    Σ_i [c_i]P_i  =  Σ_w 16^(63-w) · S_w,    S_w = Σ_i [d_{i,w}] T_i
+Every term's scalar is first brought under 2^128 on the host: the random
+blinders z_i are 128-bit by construction, and the two full-width (253-bit)
+coefficients — the basepoint coefficient and the per-key A coefficients —
+are split c = c_lo + 2^128·c_hi into TWO terms [c_lo]P and [c_hi]([2^128]P),
+with [2^128]P computed exactly on the host (and cached per verification key
+by batch.py).  That halves the window count of the whole MSM: 32 radix-16
+windows instead of 64.
 
-where T_i is the 16-entry multiples table of P_i.  The per-window sums S_w
-for ALL windows are computed together — the window axis just becomes another
-vector axis — so the doublings of the Horner combine run on ONE lane instead
-of per-term: ~(15 table + 64 window-sum) point-add lanes of work per term,
-versus ~506 for naive bit-serial double-and-add.
+Writing each scalar in 32 MSB-first radix-16 windows c_i = Σ_w 16^(31-w)·d_{i,w}:
 
-Kernel stages (each a lax.scan with a fixed-size body, so compile time is
-independent of batch size):
+    Σ_i [c_i]P_i  =  Σ_w 16^(31-w) · S_w,    S_w = Σ_i [d_{i,w}] T_i
+
+where T_i is the 16-entry multiples table of P_i.  The device computes ONLY
+the 32 per-window sums S_w — embarrassingly parallel over terms and windows —
+and the tiny serial tail (the Horner combine: 4 doublings + 1 add per
+window) runs on the HOST in exact bigint arithmetic.  This matters twice:
+the serial single-lane tail was pure latency on the device, and the final
+accept/reject math stays in exact host integers (BASELINE.json north star).
+
+Device kernel stages (each a lax.scan with a fixed-size body, so compile
+time is independent of batch size):
 
   1. table scan: T_j = T_{j-1} + P (15 steps, N lanes) → (16, 4, NLIMBS, N)
   2. block scan over N/G lane blocks (G = 128): one-hot-select each term's
-     window digits from its table (exact int32 einsum — a gather with
-     predictable TPU lowering) and point-add into a (4, NLIMBS, 64, G)
-     accumulator: 64 windows × G lanes wide per step.
-  3. a 7-level tree folds G → 1: per-window sums (4, NLIMBS, 64)
-  4. Horner scan over the 64 windows (MSB first): acc ← 16·acc + S_w
-     (4 doublings + 1 add on a single lane per step).
+     window digits from its table and point-add into a
+     (4, NLIMBS, 32, G) accumulator: 32 windows × G lanes wide per step.
+  3. a tree fold G → 1: per-window sums (4, NLIMBS, 32) — the output.
 
 All point ops use the COMPLETE addition law (jnp_edwards), so identity
 padding, zero digits, and torsion points need no branches — no
 data-dependent control flow anywhere (SURVEY.md §2.3).
 
-The host wrapper pads the term list to a power-of-two lane count with
+The host wrapper pads the term list to a multiple of G lanes with
 (scalar=0, point=identity) terms — [0]P = identity makes padding harmless —
-and unpacks the single resulting point back to exact host integers.  All
-accept/reject logic stays on the host (batch.py)."""
+and returns a `PendingMSM` handle so callers can pipeline many batches:
+dispatch is async (device_put H2D + kernel launch + copy_to_host_async
+D2H), and `.result()` blocks, Horner-combines the window sums in exact host
+integers, and returns the host Point.  All accept/reject logic stays on the
+host (batch.py)."""
 
 import functools
 
 import numpy as np
 
 from . import limbs
-from .edwards import Point
+from .edwards import Point, shift128
 from .limbs import NLIMBS
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
 
 WINDOW_BITS = 4
-NWINDOWS = 64  # ceil(256 / WINDOW_BITS); scalars up to 2^256 supported
-# Lane-block width of the reduction scan (stage 2/3).
+NWINDOWS = 32  # radix-16 windows covering the uniform 128-bit scalars
+MASK128 = (1 << 128) - 1
+# Lane-block width of the reduction scan (stage 2).
 GROUP_LANES = 128
 
 
@@ -56,12 +67,41 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _pad_lanes(n: int) -> int:
+    """Lane count for n terms: a multiple of GROUP_LANES (tight — padding is
+    pure wasted work), or a small power of two for tiny batches."""
+    if n <= GROUP_LANES:
+        return max(_MIN_LANES, _next_pow2(n))
+    return -(-n // GROUP_LANES) * GROUP_LANES
+
+
+def split_terms(scalars, points, shifts=None):
+    """Reduce arbitrary-width (≤ 2^256) scalars to uniform 128-bit terms.
+
+    Each term with c ≥ 2^128 becomes [c & MASK128]P + [c >> 128]([2^128]P).
+    `shifts`, if given, is a parallel list whose entries are either None or
+    a precomputed [2^128]·points[i] (batch.py caches these per key)."""
+    out_s, out_p = [], []
+    for i, (c, pt) in enumerate(zip(scalars, points)):
+        c = int(c)
+        hi = c >> 128
+        out_s.append(c & MASK128)
+        out_p.append(pt)
+        if hi:
+            sp = shifts[i] if shifts is not None and shifts[i] is not None \
+                else shift128(pt)
+            out_s.append(hi)
+            out_p.append(sp)
+    return out_s, out_p
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
-    """Build and jit the windowed MSM kernel for a fixed lane count.
+    """Build and jit the windowed per-window-sum kernel for a fixed lane
+    count.
     Input: digits (nwin, N) int32 in [0, 16), MSB-first windows;
            points (4, NLIMBS, N) int32.
-    Output: (4, NLIMBS, 1) — the full MSM sum as one point."""
+    Output: (4, NLIMBS, nwin) — the per-window sums S_w."""
     import jax
     import jax.numpy as jnp
 
@@ -120,55 +160,89 @@ def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
             half = g // 2
             acc = E.point_add(acc[..., :half], acc[..., half:])
             g = half
-        window_sums = acc[..., 0]  # (4, NLIMBS, nwin)
-
-        # --- stage 4: Horner combine over windows (MSB first) ----------
-        sums_seq = jnp.moveaxis(window_sums, -1, 0)[..., None]  # (nwin,4,NL,1)
-
-        def horner_body(a, s_w):
-            for _ in range(WINDOW_BITS):
-                a = E.point_double(a)
-            return E.point_add(a, s_w), None
-
-        out, _ = jax.lax.scan(
-            horner_body, E.identity_like(sums_seq[0]), sums_seq
-        )
-        return out  # (4, NLIMBS, 1)
+        return acc[..., 0]  # (4, NLIMBS, nwin)
 
     return jax.jit(kernel)
 
 
 def pack_msm_operands(scalars, points, n_lanes: int | None = None):
-    """Pack (scalars, host Points) into padded device operands.
+    """Pack 128-bit (scalars, host Points) into padded device operands.
 
     Returns (digits, point_limbs) numpy arrays of shapes
-    (NWINDOWS, N) / (4, NLIMBS, N) with N = next_pow2(len) ≥ _MIN_LANES.
+    (NWINDOWS, N) / (4, NLIMBS, N) with N = _pad_lanes(len).
     Padding terms are scalar 0 on the identity point."""
     scalars = [int(s) for s in scalars]
     if len(scalars) != len(points):
         raise ValueError("scalar/point length mismatch")
     n = len(scalars)
-    N = n_lanes if n_lanes is not None else max(_MIN_LANES, _next_pow2(n))
+    N = n_lanes if n_lanes is not None else _pad_lanes(n)
     if N < n:
         raise ValueError("n_lanes must be ≥ len(scalars)")
     digits = np.zeros((NWINDOWS, N), dtype=np.int32)
     if n:
-        digits[:, :n] = limbs.pack_scalar_windows(scalars)
+        digits[:, :n] = limbs.pack_scalar_windows(scalars, NWINDOWS)
     pts = limbs.identity_point_batch(N)
     if n:
         pts[..., :n] = limbs.pack_point_batch(points)
     return digits, pts
 
 
-def device_msm(scalars, points) -> Point:
+def combine_window_sums(window_sums) -> Point:
+    """Exact host Horner combine of the device per-window sums (MSB first):
+    acc ← [16]acc + S_w.  ~32·(4 dbl + 1 add) exact bigint point ops — the
+    serial tail that would be pure latency on the device."""
+    ws = np.asarray(window_sums)
+    acc = Point(0, 1, 1, 0)
+    for w in range(ws.shape[-1]):
+        for _ in range(WINDOW_BITS):
+            acc = acc.double()
+        acc = acc.add(limbs.unpack_point(ws[..., w]))
+    return acc
+
+
+class PendingMSM:
+    """An in-flight device MSM.  `result()` blocks on the D2H copy, then
+    Horner-combines the 32 window sums in exact host integers."""
+
+    __slots__ = ("_dev_out",)
+
+    def __init__(self, dev_out):
+        self._dev_out = dev_out
+
+    def result(self) -> Point:
+        return combine_window_sums(np.asarray(self._dev_out))
+
+
+def device_msm_async(scalars, points, shifts=None) -> PendingMSM:
+    """Dispatch Σ[c_i]P_i to the default JAX device without blocking.
+
+    H2D uses jax.device_put (the fast transfer path), the kernel launch is
+    async, and the (tiny, 4×NLIMBS×32) result starts its D2H copy
+    immediately — so many batches can be in flight at once."""
+    import jax
+
+    if not len(scalars):
+        # empty MSM: identity, no device round-trip
+        class _Done:
+            def result(self):
+                return Point(0, 1, 1, 0)
+
+        return _Done()
+    scalars, points = split_terms(scalars, points, shifts)
+    digits, pts = pack_msm_operands(scalars, points)
+    kernel = _compiled_kernel(digits.shape[1], digits.shape[0])
+    out = kernel(jax.device_put(digits), jax.device_put(pts))
+    try:
+        out.copy_to_host_async()
+    except AttributeError:
+        pass
+    return PendingMSM(out)
+
+
+def device_msm(scalars, points, shifts=None) -> Point:
     """Exact Σ[c_i]P_i computed on the default JAX device; returns a host
     Point (projective coordinates, unnormalized Z).
 
     The group reduction is commutative/associative, so lane order never
     affects the result."""
-    if not len(scalars):
-        return Point(0, 1, 1, 0)
-    digits, pts = pack_msm_operands(scalars, points)
-    kernel = _compiled_kernel(digits.shape[1], digits.shape[0])
-    out = np.asarray(kernel(digits, pts))
-    return limbs.unpack_point(out[..., 0])
+    return device_msm_async(scalars, points, shifts).result()

@@ -1,14 +1,16 @@
-"""Multi-chip MSM: shard the n+m+1 verification terms across a device mesh,
-reduce per-chip partial sums in the Edwards group, all-reduce over ICI.
+"""Multi-chip MSM: shard the verification terms across a device mesh,
+reduce per-chip partial window sums in the Edwards group, all-reduce over
+ICI.
 
 Design (SURVEY.md §2.3, BASELINE.json north star): the MSM terms are
 independent, so the mesh is 1-D data parallelism over the term axis.  Each
-chip runs the same scan kernel as the single-chip path on its shard and
-reduces it to ONE extended-coordinates point; the partial sums are
-all-gathered (a 4×NLIMBS×1 int32 tensor per chip — a few hundred bytes
-riding ICI) and folded with Edwards addition, which is commutative and
-associative, so any reduction order/tree is valid.  The final cofactor-mul
-and identity check stay on the host (batch.py), as always.
+chip runs the same per-window-sum kernel as the single-chip path
+(ops/msm.py) on its shard, producing 32 partial window sums; the partials
+are all-gathered (a 4×NLIMBS×32 int32 tensor per chip — ~10 KB riding ICI)
+and folded with Edwards addition, which is commutative and associative, so
+any reduction order/tree is valid.  The serial Horner combine over windows
+and the final cofactor-mul/identity check stay on the host in exact bigint
+arithmetic (batch.py), as always.
 
 Note the collective is an `all_gather` + group fold rather than `psum`:
 lax.psum would add LIMB TENSORS elementwise, which is not the group
@@ -19,39 +21,43 @@ import functools
 
 import numpy as np
 
-from ..ops import limbs
 from ..ops.edwards import Point
+from ..ops import msm as msm_lib
 from . import mesh as mesh_lib
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
-                             nbits: int):
+                             nwin: int):
     """jit a shard_map'd MSM over a 1-D batch mesh.
 
-    Input shapes (global): bits (nbits, N), points (4, NLIMBS, N) with
-    N = n_devices * lanes_per_device; output: replicated (4, NLIMBS, 1)."""
+    Input shapes (global): digits (nwin, N), points (4, NLIMBS, N) with
+    N = n_devices * lanes_per_device; output: replicated
+    (4, NLIMBS, nwin) window sums."""
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
 
     from ..ops import jnp_edwards as E
-    from ..ops import msm as msm_lib
 
     mesh = mesh_lib.batch_mesh(n_devices)
     axis = mesh_lib.BATCH_AXIS
 
     local_kernel = msm_lib._compiled_kernel.__wrapped__(
-        lanes_per_device, nbits
+        lanes_per_device, nwin
     )  # un-jitted builder result is already a jit fn; call inside shard_map
 
-    def shard_fn(bits, points):
-        # Per-device shard: (nbits, N/D), (4, NLIMBS, N/D)
-        part = local_kernel(bits, points)  # (4, NLIMBS, 1)
-        # ICI all-reduce in the Edwards group: gather the D partial sums
-        # and fold them with the complete addition law.
-        gathered = jax.lax.all_gather(part, axis)  # (D, 4, NLIMBS, 1)
+    def shard_fn(digits, points):
+        # Per-device shard: (nwin, N/D), (4, NLIMBS, N/D)
+        part = local_kernel(digits, points)  # (4, NLIMBS, nwin)
+        # ICI all-reduce in the Edwards group: gather the D partial window
+        # sums and fold them with the complete addition law (vectorized
+        # over the window axis).
+        gathered = jax.lax.all_gather(part, axis)  # (D, 4, NLIMBS, nwin)
 
         def fold(acc, p):
             return E.point_add(acc, p), None
@@ -59,17 +65,20 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
         out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]), gathered)
         return out
 
-    fn = shard_map(
-        shard_fn,
+    kwargs = dict(
         mesh=mesh,
         in_specs=(P(None, axis), P(None, None, axis)),
         out_specs=P(),  # replicated result
-        check_rep=False,
     )
+    try:  # the replication-check kwarg was renamed across jax versions
+        fn = shard_map(shard_fn, check_vma=False, **kwargs)
+    except TypeError:
+        fn = shard_map(shard_fn, check_rep=False, **kwargs)
     return jax.jit(fn), mesh
 
 
-def sharded_device_msm(scalars, points, n_devices: int | None = None) -> Point:
+def sharded_device_msm(scalars, points, n_devices: int | None = None,
+                       shifts=None) -> Point:
     """Exact Σ[c_i]P_i sharded over `n_devices` (default: all devices).
     Semantics identical to ops.msm.device_msm; padding terms are
     (0, identity) and harmless."""
@@ -79,19 +88,14 @@ def sharded_device_msm(scalars, points, n_devices: int | None = None) -> Point:
         n_devices = len(jax.devices())
     if not len(scalars):
         return Point(0, 1, 1, 0)
-    # Pad the term count to a lane multiple of n_devices * MIN block.
+    scalars, points = msm_lib.split_terms(scalars, points, shifts)
+    # Pad the term count so each device holds an equal power-of-two shard.
     n = len(scalars)
     per_dev = 1
     while n_devices * per_dev < max(n, 8 * n_devices):
         per_dev <<= 1
     N = n_devices * per_dev
-    bits, pts = _pack_padded(scalars, points, N)
-    kernel, _ = _compiled_sharded_kernel(n_devices, per_dev, bits.shape[0])
-    out = np.asarray(kernel(bits, pts))
-    return limbs.unpack_point(out[..., 0])
-
-
-def _pack_padded(scalars, points, N):
-    from ..ops import msm as msm_lib
-
-    return msm_lib.pack_msm_operands(scalars, points, n_lanes=N)
+    digits, pts = msm_lib.pack_msm_operands(scalars, points, n_lanes=N)
+    kernel, _ = _compiled_sharded_kernel(n_devices, per_dev, digits.shape[0])
+    out = np.asarray(kernel(digits, pts))
+    return msm_lib.combine_window_sums(out)
