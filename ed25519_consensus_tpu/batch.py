@@ -21,6 +21,8 @@ TPU/JAX limb kernel (`backend="device"`, see ops/msm.py)."""
 import hashlib
 import secrets
 
+import numpy as np
+
 from .error import InvalidSignature
 from .ops import edwards, scalar
 from .signature import Signature
@@ -90,14 +92,118 @@ _shift128_cache = {}
 _SHIFT_CACHE_MAX = 1 << 16
 
 
-def _shift128_for_key(vk_bytes: bytes, A) -> "object":
+def _shift128_for_key(vk_bytes: bytes, A_row) -> "object":
+    """Cached [2^128]A; `A_row` is the key's raw 128-byte coordinate row
+    (only touched on a cache miss)."""
     sp = _shift128_cache.get(vk_bytes)
     if sp is None:
-        sp = edwards.shift128(A)
+        from . import native
+
+        sp = edwards.shift128(native.point_from_raw(A_row))
         if len(_shift128_cache) >= _SHIFT_CACHE_MAX:
             _shift128_cache.pop(next(iter(_shift128_cache)))
         _shift128_cache[vk_bytes] = sp
     return sp
+
+
+_B_RAW_ROW = None
+
+
+def _basepoint_raw_row() -> "np.ndarray":
+    """(1, 128) uint8 canonical coordinate row for the basepoint."""
+    global _B_RAW_ROW
+    if _B_RAW_ROW is None:
+        from .ops.field import P
+
+        B = edwards.BASEPOINT
+        row = b"".join(
+            (c % P).to_bytes(32, "little") for c in (B.X, B.Y, B.Z, B.T)
+        )
+        _B_RAW_ROW = np.frombuffer(row, dtype=np.uint8).reshape(1, 128)
+    return _B_RAW_ROW
+
+
+class StagedBatch:
+    """A staged (host-validated) batch in flat buffer form.
+
+    * coeffs: [B_coeff] + per-key A_coeffs, ints mod ℓ (may exceed 2^128 —
+      the device path splits them against `coeff_shifts`).
+    * coeff_shifts: matching [2^128]·point host Points (basepoint constant
+      + per-key cache).
+    * z_ints: the n per-signature 128-bit blinders.
+    * raw_points: ((1+m+n), 128) uint8 — canonical X‖Y‖Z‖T rows for
+      [B, A_0..A_{m-1}, R_0..R_{n-1}]; columns/terms order is
+      [coeff terms..., split-high terms..., R terms...]."""
+
+    __slots__ = ("coeffs", "coeff_shifts", "z_ints", "raw_points")
+
+    def __init__(self, coeffs, coeff_shifts, z_ints, raw_points):
+        self.coeffs = coeffs
+        self.coeff_shifts = coeff_shifts
+        self.z_ints = z_ints
+        self.raw_points = raw_points
+
+    @property
+    def n_terms(self) -> int:
+        return len(self.coeffs) + len(self.z_ints)
+
+    @property
+    def n_device_terms(self) -> int:
+        """Exact device term count: n_terms plus one split-high term for
+        every coefficient exceeding 128 bits (what device_operands
+        emits)."""
+        return self.n_terms + sum(1 for c in self.coeffs if c >> 128)
+
+    def host_msm(self):
+        """The host-backend MSM over the staged terms (native C++ Straus
+        when available)."""
+        from . import native
+
+        return native.vartime_msm_buffer(
+            self.coeffs + self.z_ints, self.raw_points
+        )
+
+    def device_operands(self, pad_fn):
+        """Build the padded (digits (32, N) int32, points (4, NLIMBS, N)
+        int32) device operands: coefficients split into 128-bit chunks
+        against their shift points, blinder digits and point limbs packed
+        vectorized from the raw buffers."""
+        from .ops import limbs
+
+        mask = (1 << 128) - 1
+        lo = [c & mask for c in self.coeffs]
+        hi_s, hi_p = [], []
+        for c, sp in zip(self.coeffs, self.coeff_shifts):
+            h = c >> 128
+            if h:
+                hi_s.append(h)
+                hi_p.append(sp)
+        n_coeff = len(lo)
+        n_head = n_coeff + len(hi_s)
+        n = n_head + len(self.z_ints)
+        N = pad_fn(n)
+        digits = np.zeros((limbs.NWINDOWS, N), dtype=np.int8)
+        digits[:, :n_coeff] = limbs.pack_scalar_windows(lo)
+        if hi_s:
+            digits[:, n_coeff:n_head] = limbs.pack_scalar_windows(hi_s)
+        if self.z_ints:
+            zb = np.frombuffer(
+                b"".join(z.to_bytes(16, "little") for z in self.z_ints),
+                dtype=np.uint8,
+            ).reshape(len(self.z_ints), 16)
+            digits[:, n_head:n] = limbs.pack_u128_windows(zb)
+        pts = limbs.identity_point_batch(N)
+        pts[..., :n_coeff] = limbs.pack_points_from_raw(
+            self.raw_points[:n_coeff]
+        )
+        if hi_p:
+            pts[..., n_coeff:n_head] = limbs.pack_point_batch(
+                hi_p
+            ).astype(np.int16)
+        pts[..., n_head:n] = limbs.pack_points_from_raw(
+            self.raw_points[n_coeff:]
+        )
+        return digits, pts
 
 
 class Verifier:
@@ -120,53 +226,60 @@ class Verifier:
 
     # -- staging (host, exact) --------------------------------------------
 
-    def _stage(self, rng):
+    def _stage(self, rng) -> "StagedBatch":
         """Host staging: decompress all points, enforce `s < ℓ`, sample
-        blinders, coalesce per-key A coefficients.  Returns the flat MSM
-        term list plus the cached [2^128]·point shifts the device backend
-        uses for its 128-bit scalar split: (scalars, points, shifts), with
-        shifts[i] = None where no precomputed shift exists (R terms — their
-        blinders are < 2^128 and never split).  Raises InvalidSignature on
-        ANY malformed input — before any device dispatch (all-or-nothing
-        semantics, reference src/batch.rs:139-147, 182-203)."""
+        blinders, coalesce per-key A coefficients.  Returns a StagedBatch —
+        the flat MSM term list in buffer form (canonical point bytes +
+        coefficient ints + blinder bytes), ready for any backend without
+        per-point Python objects.  Raises InvalidSignature on ANY
+        malformed input — before any device dispatch (all-or-nothing
+        semantics, reference src/batch.rs:139-147, 182-203).
+
+        The coalescing sums Σ z·s and Σ z·k accumulate UNREDUCED (plain
+        int adds; one `mod ℓ` per final coefficient) — the per-term modular
+        reductions were the staging hot spot and are mathematically
+        unnecessary."""
         from . import native
+        from .ops.scalar import L
 
         groups = list(self.signatures.items())
+        m = len(groups)
+        n = self.batch_size
         # One batched (native if available, exact either way) decompression
-        # of all m keys and n R values — the host staging hot spot.
-        encodings = [vkb.to_bytes() for vkb, _ in groups]
+        # of all m keys and n R values into a raw coordinate buffer.
+        parts = [vkb.to_bytes() for vkb, _ in groups]
         for _, sigs in groups:
-            encodings.extend(sig.R_bytes for _, sig in sigs)
-        decompressed = native.decompress_batch(encodings)
-        A_points = decompressed[: len(groups)]
-        R_points = iter(decompressed[len(groups) :])
+            parts.extend(sig.R_bytes for _, sig in sigs)
+        raw, ok = native.decompress_batch_buffer(b"".join(parts), m + n)
+        if not ok.all():
+            raise InvalidSignature()
 
-        B_coeff = 0
-        A_coeffs, As, A_shifts = [], [], []
-        R_coeffs, Rs = [], []
-        for (vk_bytes, sigs), A in zip(groups, A_points):
-            if A is None:
-                raise InvalidSignature()
-            A_coeff = 0
+        B_acc = 0
+        A_coeffs, A_shifts = [], []
+        z_ints = []
+        for (vk_bytes, sigs), A_row in zip(groups, raw[:m]):
+            a_acc = 0
             for k, sig in sigs:
-                R = next(R_points)
-                if R is None:
-                    raise InvalidSignature()
-                s = scalar.from_canonical_bytes(sig.s_bytes)
-                if s is None:
+                s = int.from_bytes(sig.s_bytes, "little")
+                if s >= L:  # ZIP215 rule 2: s MUST be canonical
                     raise InvalidSignature()
                 z = gen_u128(rng)
-                B_coeff = scalar.sub(B_coeff, scalar.mul(z, s))
-                Rs.append(R)
-                R_coeffs.append(scalar.reduce(z))
-                A_coeff = scalar.add(A_coeff, scalar.mul(z, k))
-            As.append(A)
-            A_shifts.append(_shift128_for_key(vk_bytes.to_bytes(), A))
-            A_coeffs.append(A_coeff)
-        scalars = [B_coeff] + A_coeffs + R_coeffs
-        points = [edwards.BASEPOINT] + As + Rs
-        shifts = [edwards.basepoint_shift128()] + A_shifts + [None] * len(Rs)
-        return scalars, points, shifts
+                B_acc += z * s
+                a_acc += z * k
+                z_ints.append(z)
+            A_coeffs.append(a_acc % L)
+            A_shifts.append(
+                _shift128_for_key(vk_bytes.to_bytes(), A_row)
+            )
+        raw_points = np.concatenate(
+            [_basepoint_raw_row(), raw], axis=0
+        )  # rows: [B, A_0..A_{m-1}, R_0..R_{n-1}]
+        return StagedBatch(
+            coeffs=[(-B_acc) % L] + A_coeffs,
+            coeff_shifts=[edwards.basepoint_shift128()] + A_shifts,
+            z_ints=z_ints,
+            raw_points=raw_points,
+        )
 
     # -- verification ------------------------------------------------------
 
@@ -199,13 +312,11 @@ class Verifier:
         metrics.batch_size = self.batch_size
         metrics.distinct_keys = len(self.signatures)
         with metrics.stage("stage_host"):
-            scalars, points, shifts = self._stage(rng)
-        metrics.msm_terms = len(scalars)
+            staged = self._stage(rng)
+        metrics.msm_terms = staged.n_terms
         if backend == "host":
             with metrics.stage("msm"):
-                from . import native
-
-                check = native.vartime_msm(scalars, points)
+                check = staged.host_msm()
         elif backend == "device":
             try:
                 from .ops import msm
@@ -214,7 +325,10 @@ class Verifier:
                     "device MSM backend unavailable: " + str(e)
                 ) from e
             with metrics.stage("msm"):
-                check = msm.device_msm(scalars, points, shifts)
+                digits, pts = staged.device_operands(msm.preferred_pad)
+                check = msm.PendingMSM(
+                    msm.dispatch_window_sums(digits, pts)
+                ).result()
         elif backend == "sharded":
             try:
                 from .parallel import sharded_msm
@@ -223,9 +337,7 @@ class Verifier:
                     "sharded MSM backend unavailable: " + str(e)
                 ) from e
             with metrics.stage("msm"):
-                check = sharded_msm.sharded_device_msm(
-                    scalars, points, shifts=shifts
-                )
+                check = sharded_msm.sharded_staged_msm(staged)
         else:
             raise ValueError(f"unknown backend {backend!r}")
         # Final cofactored identity check: host-exact, always.
@@ -249,13 +361,52 @@ class Verifier:
                 "device MSM backend unavailable: " + str(e)
             ) from e
 
-        scalars, points, shifts = self._stage(rng)
-        return PendingVerification(msm.device_msm_async(scalars, points, shifts))
+        staged = self._stage(rng)
+        digits, pts = staged.device_operands(msm.preferred_pad)
+        return PendingVerification(
+            msm.PendingMSM(msm.dispatch_window_sums(digits, pts))
+        )
 
     def verify_tpu(self, rng=None) -> None:
         """Convenience entry point for the device backend (the analog of the
         north-star `Verifier::verify_tpu()`)."""
         self.verify(rng=rng, backend="device")
+
+
+def verify_many(verifiers, rng=None) -> "list[bool]":
+    """Verify MANY independent batches in ONE device call.
+
+    On a remote-attached TPU the per-call round-trip dominates a batch's
+    device cost, so the steady-state throughput path stacks the packed
+    operands of every batch (padded to a common lane count) behind a single
+    batched kernel launch and a single result fetch.  Returns a verdict per
+    verifier (True = every queued signature valid); each verdict is decided
+    by the same exact host math as `verify` (staging rejections included —
+    a batch that fails host staging is simply verdict False here).
+    """
+    from .ops import msm
+
+    verifiers = list(verifiers)
+    verdicts = [False] * len(verifiers)
+    staged_list, idxs = [], []
+    for i, v in enumerate(verifiers):
+        try:
+            staged_list.append(v._stage(rng))
+            idxs.append(i)
+        except InvalidSignature:
+            pass  # malformed input: verdict stays False
+    if not staged_list:
+        return verdicts
+    # Pack all batches to one common lane count and stack.
+    pad = max(msm.preferred_pad(s.n_device_terms) for s in staged_list)
+    ops = [s.device_operands(lambda n: pad) for s in staged_list]
+    digits = np.stack([d for d, _ in ops])
+    pts = np.stack([p for _, p in ops])
+    out = np.asarray(msm.dispatch_window_sums_many(digits, pts))
+    for j, i in enumerate(idxs):
+        check = msm.combine_window_sums(out[j])
+        verdicts[i] = check.mul_by_cofactor().is_identity()
+    return verdicts
 
 
 class PendingVerification:

@@ -190,6 +190,56 @@ def decompress_batch(encodings):
     return [edwards.decompress(e) for e in encodings]
 
 
+def decompress_batch_buffer(blob: bytes, n: int):
+    """Batched ZIP215 decompression, buffer form: `blob` is n
+    concatenated 32-byte encodings; returns (raw, ok) numpy arrays of
+    shapes (n, 128) uint8 / (n,) uint8.  `raw` rows are canonical X‖Y‖Z‖T
+    32-byte little-endian coords — exactly the limb-packing input format
+    (ops/limbs.pack_points_from_raw) and the native-MSM point format, so
+    the staging path never materializes per-point Python objects."""
+    import numpy as np
+
+    lib = load()
+    if lib is not None:
+        out = ctypes.create_string_buffer(128 * n)
+        ok = ctypes.create_string_buffer(n)
+        lib.zip215_decompress_batch(blob, n, out, ok)
+        return (
+            np.frombuffer(out.raw, dtype=np.uint8).reshape(n, 128).copy(),
+            np.frombuffer(ok.raw, dtype=np.uint8).copy(),
+        )
+    # Exact-Python fallback (CI without a toolchain).
+    from ..ops import edwards
+    from ..ops.field import P
+
+    raw = np.zeros((n, 128), dtype=np.uint8)
+    ok = np.zeros((n,), dtype=np.uint8)
+    for i in range(n):
+        pt = edwards.decompress(blob[32 * i : 32 * (i + 1)])
+        if pt is None:
+            continue
+        ok[i] = 1
+        row = b"".join(
+            (c % P).to_bytes(32, "little")
+            for c in (pt.X, pt.Y, pt.Z, pt.T)
+        )
+        raw[i] = np.frombuffer(row, dtype=np.uint8)
+    return raw, ok
+
+
+def point_from_raw(row) -> "object":
+    """One (128,) uint8 raw row → exact host Point."""
+    from ..ops.edwards import Point
+
+    b = bytes(row)
+    return Point(
+        int.from_bytes(b[0:32], "little"),
+        int.from_bytes(b[32:64], "little"),
+        int.from_bytes(b[64:96], "little"),
+        int.from_bytes(b[96:128], "little"),
+    )
+
+
 def _point128(pt) -> bytes:
     from ..ops.field import P
 
@@ -224,6 +274,24 @@ def vartime_msm(scalars, points):
     from ..ops import edwards
 
     return edwards.multiscalar_mul(scalars, points)
+
+
+def vartime_msm_buffer(scalars, raw_points):
+    """Σ[c_i]P_i with points given as a (T, 128) uint8 raw buffer (the
+    decompress_batch_buffer format) — the host-backend MSM without any
+    per-point Python objects.  Exact-Python fallback."""
+    lib = load()
+    if lib is None:
+        from ..ops import edwards
+
+        return edwards.multiscalar_mul(
+            scalars, [point_from_raw(r) for r in raw_points]
+        )
+    n = len(scalars)
+    sblob = b"".join(int(s).to_bytes(32, "little") for s in scalars)
+    out = ctypes.create_string_buffer(128)
+    lib.edwards_vartime_msm(sblob, raw_points.tobytes(), n, out)
+    return point_from_raw(out.raw)
 
 
 def check_prehashed(minus_A, R, k: int, s: int) -> bool:

@@ -99,27 +99,74 @@ def pack_scalar_bits(scalars, nbits: int = SCALAR_BITS) -> np.ndarray:
 
 
 WINDOW_BITS = 4
-NWINDOWS = 32  # radix-16 windows covering the uniform 128-bit scalars
+# Signed radix-16: 32 nibble windows for the uniform 128-bit scalars plus
+# one carry window from the signed recoding (digits in [-8, 8]).
+NWINDOWS = 33
+
+
+def _recode_signed(d_le: np.ndarray) -> np.ndarray:
+    """Unsigned little-endian nibble digits (n, W) → signed digits
+    (n, W+1) int8 with every digit in [-8, 8]: d > 8 becomes d - 16 with a
+    carry into the next window (vectorized over the batch)."""
+    n, W = d_le.shape
+    out = np.zeros((n, W + 1), dtype=np.int8)
+    carry = np.zeros(n, dtype=np.int32)
+    for w in range(W):
+        v = d_le[:, w].astype(np.int32) + carry
+        carry = (v > 8).astype(np.int32)
+        out[:, w] = (v - 16 * carry).astype(np.int8)
+    out[:, W] = carry.astype(np.int8)
+    return out
 
 
 def pack_scalar_windows(scalars, nwindows: int = NWINDOWS) -> np.ndarray:
-    """Pack scalars (< 16^nwindows) into MSB-first radix-16 digit planes
-    (nwindows, N) int32 (vectorized via np.unpackbits)."""
-    nbytes = (nwindows * WINDOW_BITS + 7) // 8
+    """Pack scalars (< 16^(nwindows-1)) into MSB-first SIGNED radix-16
+    digit planes (nwindows, N) int8, digits in [-8, 8] (vectorized via
+    np.unpackbits + carry recoding)."""
+    nub = nwindows - 1  # unsigned nibble windows before recoding
+    nbytes = (nub * WINDOW_BITS + 7) // 8
     for s in scalars:
-        if s >> (nwindows * WINDOW_BITS):
-            raise ValueError(f"scalar exceeds {nwindows} radix-16 windows")
-    bits = _ints_to_bits(scalars, nbytes)[:, : nwindows * WINDOW_BITS]
+        if s >> (nub * WINDOW_BITS):
+            raise ValueError(f"scalar exceeds {nub} radix-16 windows")
+    bits = _ints_to_bits(scalars, nbytes)[:, : nub * WINDOW_BITS]
     w = (1 << np.arange(WINDOW_BITS, dtype=np.int32)).astype(np.int32)
-    digits = bits.reshape(len(scalars), nwindows, WINDOW_BITS).astype(
+    digits = bits.reshape(len(scalars), nub, WINDOW_BITS).astype(
         np.int32
-    ) @ w  # (N, nwindows) little-endian window order
-    return digits[:, ::-1].T.copy()
+    ) @ w  # (N, nub) little-endian window order
+    return np.ascontiguousarray(_recode_signed(digits)[:, ::-1].T)
+
+
+def pack_points_from_raw(raw: np.ndarray) -> np.ndarray:
+    """Vectorized limb packing straight from canonical point bytes:
+    (T, 128) uint8 rows of X‖Y‖Z‖T 32-byte little-endian encodings (the
+    native decompression output format) → (4, NLIMBS, T) int16 (13-bit
+    limbs always fit; halves the H2D transfer) — no per-point Python
+    objects anywhere."""
+    n = raw.shape[0]
+    coords = raw.reshape(n, 4, 32)
+    bits = np.unpackbits(coords, axis=2, bitorder="little")  # (n, 4, 256)
+    bits = np.concatenate(
+        [bits, np.zeros((n, 4, NLIMBS * LIMB_BITS - 256), np.uint8)], axis=2
+    )
+    limbs13 = bits.reshape(n, 4, NLIMBS, LIMB_BITS).astype(np.int16)
+    vals = limbs13 @ _LIMB_WEIGHTS.astype(np.int16)  # (n, 4, NLIMBS)
+    return np.ascontiguousarray(np.moveaxis(vals, 0, 2))
+
+
+def pack_u128_windows(zb: np.ndarray) -> np.ndarray:
+    """Vectorized digit packing for 128-bit blinders: (n, 16) uint8
+    little-endian rows → (NWINDOWS, n) int8 MSB-first signed radix-16
+    digit planes."""
+    n = zb.shape[0]
+    bits = np.unpackbits(zb, axis=1, bitorder="little")  # (n, 128)
+    w = (1 << np.arange(WINDOW_BITS, dtype=np.int32)).astype(np.int32)
+    digits = bits.reshape(n, 32, WINDOW_BITS).astype(np.int32) @ w
+    return np.ascontiguousarray(_recode_signed(digits)[:, ::-1].T)
 
 
 def identity_point_batch(n: int) -> np.ndarray:
-    """(4, NLIMBS, n) batch of the identity (0 : 1 : 1 : 0)."""
-    out = np.zeros((4, NLIMBS, n), dtype=np.int32)
+    """(4, NLIMBS, n) int16 batch of the identity (0 : 1 : 1 : 0)."""
+    out = np.zeros((4, NLIMBS, n), dtype=np.int16)
     out[1, 0, :] = 1
     out[2, 0, :] = 1
     return out

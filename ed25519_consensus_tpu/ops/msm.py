@@ -11,25 +11,29 @@ with [2^128]P computed exactly on the host (and cached per verification key
 by batch.py).  That halves the window count of the whole MSM: 32 radix-16
 windows instead of 64.
 
-Writing each scalar in 32 MSB-first radix-16 windows c_i = Σ_w 16^(31-w)·d_{i,w}:
+Each 128-bit scalar is recoded to NWINDOWS = 33 MSB-first SIGNED radix-16
+digits d_{i,w} ∈ [-8, 8] (limbs.py):
 
-    Σ_i [c_i]P_i  =  Σ_w 16^(31-w) · S_w,    S_w = Σ_i [d_{i,w}] T_i
+    Σ_i [c_i]P_i  =  Σ_w 16^(32-w) · S_w,    S_w = Σ_i [d_{i,w}] T_i
 
-where T_i is the 16-entry multiples table of P_i.  The device computes ONLY
-the 32 per-window sums S_w — embarrassingly parallel over terms and windows —
-and the tiny serial tail (the Horner combine: 4 doublings + 1 add per
-window) runs on the HOST in exact bigint arithmetic.  This matters twice:
-the serial single-lane tail was pure latency on the device, and the final
-accept/reject math stays in exact host integers (BASELINE.json north star).
+where T_i is the 9-entry multiples table [0..8]P_i — signed digits halve
+the table, and negation is free on balanced limbs (negate X and T).  The
+device computes ONLY the 33 per-window sums S_w — embarrassingly parallel
+over terms and windows — and the tiny serial tail (the Horner combine: 4
+doublings + 1 add per window) runs on the HOST in exact bigint
+arithmetic.  This matters twice: the serial single-lane tail was pure
+latency on the device, and the final accept/reject math stays in exact
+host integers (BASELINE.json north star).
 
-Device kernel stages (each a lax.scan with a fixed-size body, so compile
-time is independent of batch size):
+XLA kernel stages (each a lax.scan with a fixed-size body, so compile
+time is independent of batch size; the Pallas kernel in pallas_msm.py is
+the TPU-hardware version of the same contract):
 
-  1. table scan: T_j = T_{j-1} + P (15 steps, N lanes) → (16, 4, NLIMBS, N)
+  1. table scan: T_j = T_{j-1} + P (8 steps, N lanes) → (9, 4, NLIMBS, N)
   2. block scan over N/G lane blocks (G = 128): one-hot-select each term's
-     window digits from its table and point-add into a
-     (4, NLIMBS, 32, G) accumulator: 32 windows × G lanes wide per step.
-  3. a tree fold G → 1: per-window sums (4, NLIMBS, 32) — the output.
+     |digit| entry, apply the digit sign, and point-add into a
+     (4, NLIMBS, 33, G) accumulator: 33 windows × G lanes wide per step.
+  3. a tree fold G → 1: per-window sums (4, NLIMBS, 33) — the output.
 
 All point ops use the COMPLETE addition law (jnp_edwards), so identity
 padding, zero digits, and torsion points need no branches — no
@@ -53,8 +57,8 @@ from .limbs import NLIMBS
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
 
-WINDOW_BITS = 4
-NWINDOWS = 32  # radix-16 windows covering the uniform 128-bit scalars
+WINDOW_BITS = limbs.WINDOW_BITS
+NWINDOWS = limbs.NWINDOWS  # 32 signed radix-16 windows + 1 carry window
 MASK128 = (1 << 128) - 1
 # Lane-block width of the reduction scan (stage 2).
 GROUP_LANES = 128
@@ -99,9 +103,9 @@ def split_terms(scalars, points, shifts=None):
 def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
     """Build and jit the windowed per-window-sum kernel for a fixed lane
     count.
-    Input: digits (nwin, N) int32 in [0, 16), MSB-first windows;
-           points (4, NLIMBS, N) int32.
-    Output: (4, NLIMBS, nwin) — the per-window sums S_w."""
+    Input: digits (nwin, N) int8, SIGNED digits in [-8, 8], MSB-first;
+           points (4, NLIMBS, N) int16.
+    Output: (4, NLIMBS, nwin) int32 — the per-window sums S_w."""
     import jax
     import jax.numpy as jnp
 
@@ -112,39 +116,48 @@ def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
     n_blocks = n_lanes // G
 
     def kernel(digits, points):
-        # --- stage 1: per-term multiples tables ------------------------
+        digits = digits.astype(jnp.int32)
+        points = points.astype(jnp.int32)
+
+        # --- stage 1: per-term multiples tables ([0..8]P — signed digits
+        # need only half a table; negation is free on balanced limbs) ----
         def table_body(t, _):
             nxt = E.point_add(t, points)
             return nxt, nxt
 
         _, multiples = jax.lax.scan(
-            table_body, E.identity_like(points), None, length=15
-        )  # (15, 4, NLIMBS, N) = [1]P .. [15]P
+            table_body, E.identity_like(points), None, length=8
+        )  # (8, 4, NLIMBS, N) = [1]P .. [8]P
         table = jnp.concatenate(
             [E.identity_like(points)[None], multiples], axis=0
-        )  # (16, 4, NLIMBS, N)
+        )  # (9, 4, NLIMBS, N)
 
         # --- stage 2: per-window sums over lane blocks -----------------
         tbl_blocks = jnp.moveaxis(
-            table.reshape(16, 4, NLIMBS, n_blocks, G), 3, 0
-        )  # (B, 16, 4, NLIMBS, G)
+            table.reshape(9, 4, NLIMBS, n_blocks, G), 3, 0
+        )  # (B, 9, 4, NLIMBS, G)
         dig_blocks = jnp.moveaxis(
             digits.reshape(nwin, n_blocks, G), 1, 0
         )  # (B, nwin, G)
 
         def block_body(acc, xs):
             tbl, dig = xs
+            mag = jnp.abs(dig)
             onehot = (
-                dig[:, None, :] == jnp.arange(16, dtype=jnp.int32)[None, :, None]
-            ).astype(jnp.int32)  # (nwin, 16, G)
-            # Exact select: for each (window, lane), pick the digit's table
-            # entry.  Broadcast-multiply + sum over the 16-entry axis
+                mag[:, None, :] == jnp.arange(9, dtype=jnp.int32)[None, :, None]
+            ).astype(jnp.int32)  # (nwin, 9, G)
+            # Exact select: for each (window, lane), pick the |digit|'s
+            # table entry.  Broadcast-multiply + sum over the 9-entry axis
             # (NOT einsum/dot_general — integer dots lower poorly on TPU);
             # one-hot masking keeps limb magnitudes unchanged.
             sel = jnp.sum(
                 onehot[None, None] * jnp.moveaxis(tbl, 0, 2)[:, :, None],
                 axis=3,
             )  # (4, NLIMBS, nwin, G)
+            # negative digits: negate X and T (balanced limbs: limb-wise)
+            sgn = jnp.where(dig < 0, jnp.int32(-1), jnp.int32(1))
+            one = jnp.ones_like(sgn)
+            sel = sel * jnp.stack([sgn, one, one, sgn])[:, None]
             return E.point_add(acc, sel), None
 
         ident_np = np.zeros((4, NLIMBS, nwin, G), dtype=np.int32)
@@ -178,20 +191,25 @@ def pack_msm_operands(scalars, points, n_lanes: int | None = None):
     N = n_lanes if n_lanes is not None else _pad_lanes(n)
     if N < n:
         raise ValueError("n_lanes must be ≥ len(scalars)")
-    digits = np.zeros((NWINDOWS, N), dtype=np.int32)
+    digits = np.zeros((NWINDOWS, N), dtype=np.int8)
     if n:
         digits[:, :n] = limbs.pack_scalar_windows(scalars, NWINDOWS)
     pts = limbs.identity_point_batch(N)
     if n:
-        pts[..., :n] = limbs.pack_point_batch(points)
+        pts[..., :n] = limbs.pack_point_batch(points).astype(np.int16)
     return digits, pts
 
 
 def combine_window_sums(window_sums) -> Point:
     """Exact host Horner combine of the device per-window sums (MSB first):
     acc ← [16]acc + S_w.  ~32·(4 dbl + 1 add) exact bigint point ops — the
-    serial tail that would be pure latency on the device."""
+    serial tail that would be pure latency on the device.  Accepts a
+    leading singleton batch axis."""
     ws = np.asarray(window_sums)
+    if ws.ndim == 4:
+        if ws.shape[0] != 1:
+            raise ValueError("combine_window_sums takes one batch")
+        ws = ws[0]
     acc = Point(0, 1, 1, 0)
     for w in range(ws.shape[-1]):
         for _ in range(WINDOW_BITS):
@@ -213,14 +231,79 @@ class PendingMSM:
         return combine_window_sums(np.asarray(self._dev_out))
 
 
+def _use_pallas() -> bool:
+    """Kernel selection: the Mosaic kernel on real TPU backends, the XLA
+    scan kernel elsewhere (CPU CI, virtual meshes).  Overridable via
+    ED25519_TPU_MSM_KERNEL=pallas|xla."""
+    import os
+
+    mode = os.environ.get("ED25519_TPU_MSM_KERNEL", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    try:
+        import jax
+
+        return jax.devices()[0].platform.startswith("tpu")
+    except Exception:
+        return False
+
+
+def preferred_pad(n: int) -> int:
+    """Lane padding for the active kernel (Pallas wants GROUP_LANES
+    multiples; the XLA scan is happiest on its own block multiples)."""
+    if _use_pallas():
+        from . import pallas_msm
+
+        return pallas_msm.pad_lanes(n)
+    return _pad_lanes(n)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_kernel_many(n_batches: int, n_lanes: int,
+                          nwin: int = NWINDOWS):
+    """vmap of the XLA scan kernel over a leading batch axis: B independent
+    verification batches in ONE device call (the per-call tunnel round-trip
+    dominates on remote-attached devices)."""
+    import jax
+
+    kernel = _compiled_kernel.__wrapped__(n_lanes, nwin)
+    return jax.jit(jax.vmap(kernel))
+
+
+def dispatch_window_sums_many(digits, points):
+    """One device call for B stacked batches: digits (B, NWINDOWS, N),
+    points (B, 4, NLIMBS, N) numpy → (B, 4, NLIMBS, NWINDOWS) device array
+    with its D2H copy in flight."""
+    if _use_pallas():
+        from . import pallas_msm
+
+        out = pallas_msm.pallas_window_sums_many(digits, points)
+    else:
+        out = _compiled_kernel_many(digits.shape[0], digits.shape[2],
+                                    digits.shape[1])(digits, points)
+    try:
+        out.copy_to_host_async()
+    except AttributeError:
+        pass
+    return out
+
+
+def dispatch_window_sums(digits, points):
+    """Async-dispatch pre-packed operands to the active device kernel;
+    returns a (1, 4, NLIMBS, NWINDOWS) device array (PendingMSM /
+    combine_window_sums accept the leading singleton) with its D2H copy
+    already in flight."""
+    return dispatch_window_sums_many(digits[None], points[None])
+
+
 def device_msm_async(scalars, points, shifts=None) -> PendingMSM:
     """Dispatch Σ[c_i]P_i to the default JAX device without blocking.
 
-    H2D uses jax.device_put (the fast transfer path), the kernel launch is
-    async, and the (tiny, 4×NLIMBS×32) result starts its D2H copy
-    immediately — so many batches can be in flight at once."""
-    import jax
-
+    The whole device step is ONE jitted call (H2D rides the call), and the
+    tiny result starts its D2H copy immediately — so many batches can be
+    in flight at once."""
     if not len(scalars):
         # empty MSM: identity, no device round-trip
         class _Done:
@@ -229,14 +312,10 @@ def device_msm_async(scalars, points, shifts=None) -> PendingMSM:
 
         return _Done()
     scalars, points = split_terms(scalars, points, shifts)
-    digits, pts = pack_msm_operands(scalars, points)
-    kernel = _compiled_kernel(digits.shape[1], digits.shape[0])
-    out = kernel(jax.device_put(digits), jax.device_put(pts))
-    try:
-        out.copy_to_host_async()
-    except AttributeError:
-        pass
-    return PendingMSM(out)
+    digits, pts = pack_msm_operands(
+        scalars, points, n_lanes=preferred_pad(len(scalars))
+    )
+    return PendingMSM(dispatch_window_sums(digits, pts))
 
 
 def device_msm(scalars, points, shifts=None) -> Point:

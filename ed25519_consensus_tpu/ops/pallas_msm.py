@@ -1,0 +1,336 @@
+"""Pallas TPU kernel for the batch-verification MSM window sums.
+
+Same job as the XLA scan kernel in ops/msm.py (digit planes + point limbs →
+per-window sums), hand-blocked for the VPU:
+
+* **(32, 128) lane tiles.**  Every limb value in the kernel is a full
+  (sublane × lane) int32 tile — 1-D vectors would use 1 of 8 sublanes.
+  A grid step processes a block of G = 4096 terms.
+* **Signed radix-16 digits** (limbs.py recoding, d ∈ [-8, 8], 33 windows):
+  the multiples table is 9 entries ([0..8]P) instead of 16 — half the
+  table-build point-adds and half the select masks; negation is free in
+  the balanced-limb representation (negate X and T limbs).
+* **int16 table storage.**  Balanced limbs live in |x| ≤ 8191, so the VMEM
+  table stores int16 (casts are VPU-cheap) — 9×4×20×4096×2B = 5.9 MB,
+  which is what lets the whole working set fit VMEM at G = 4096.
+* **Streaming grid, no cross-block state.**  grid = (B, N/G); each step
+  builds its block's table, selects each of the 33 windows' digits, folds
+  the block's 4096 lanes down to a (8, 128) tile per window with in-tile
+  sublane-slice point-adds, and writes one (33, 4, 20, 8, 128) int16
+  output row.  The surviving 1024-lane × per-block partials are folded by
+  plain XLA inside the SAME jit (one device call per dispatch — on a
+  remote-attached TPU the per-call round-trip dominates, so the pipeline
+  also takes a leading batch axis: B independent verification batches ride
+  one launch).
+* Limb arithmetic is the same balanced-signed 20×13-bit scheme as
+  jnp_field.py (identical carry-step counts; the closure proofs in that
+  module's docstring apply verbatim) — over Python LISTS of (32, 128)
+  int32 tiles, fully unrolled, so Mosaic keeps the schoolbook product in
+  registers.
+
+The final Horner combine over windows stays exact host bigint math
+(ops/msm.py).  Parity with the exact host arithmetic is pinned by
+tests/test_pallas_msm.py (interpreter mode on the CPU backend) and by the
+device-parity suite when a TPU is attached."""
+
+import functools
+
+import numpy as np
+
+from .limbs import FOLD, LIMB_BITS, NLIMBS, NWINDOWS
+from .field import D2, P
+from . import limbs as limbs_mod
+
+_HALF = 1 << (LIMB_BITS - 1)
+
+SUBLANES = 32
+LANES = 128
+GROUP = SUBLANES * LANES  # 4096 terms per grid step
+FOLD_SUBLANES = 8         # fold each block down to (8, 128) lanes
+
+
+# -- field ops over lists of (32, 128) int32 tiles -------------------------
+# Semantics and carry-step counts match ops/jnp_field.py exactly (same
+# balanced-limb bounds U: |limb| ≤ 8191; proofs in that module).
+
+
+def _carry(xs, steps):
+    for _ in range(steps):
+        cs = [(x + _HALF) >> LIMB_BITS for x in xs]
+        rs = [x - (c << LIMB_BITS) for x, c in zip(xs, cs)]
+        xs = [rs[0] + cs[-1] * FOLD] + [
+            rs[i] + cs[i - 1] for i in range(1, len(xs))
+        ]
+    return xs
+
+
+def _fadd(a, b):
+    return _carry([x + y for x, y in zip(a, b)], 1)
+
+
+def _fsub(a, b):
+    return _carry([x - y for x, y in zip(a, b)], 1)
+
+
+def _fmul_small(a, k):
+    return _carry([x * k for x in a], 1)
+
+
+def _fmul(a, b):
+    import jax.numpy as jnp
+
+    wide = [None] * (2 * NLIMBS - 1)
+    for i in range(NLIMBS):
+        ai = a[i]
+        for j in range(NLIMBS):
+            p = ai * b[j]
+            k = i + j
+            wide[k] = p if wide[k] is None else wide[k] + p
+    zero = jnp.zeros_like(wide[0])
+    wide = wide + [zero, zero]  # two columns absorb the wide carries
+    for _ in range(2):
+        cs = [(x + _HALF) >> LIMB_BITS for x in wide]
+        rs = [x - (c << LIMB_BITS) for x, c in zip(wide, cs)]
+        wide = [rs[0]] + [rs[i] + cs[i - 1] for i in range(1, len(wide))]
+    low = [wide[i] + wide[NLIMBS + i] * FOLD for i in range(NLIMBS)]
+    low[0] = low[0] + wide[2 * NLIMBS] * (FOLD * FOLD)
+    return _carry(low, 5)
+
+
+_D2_LIMBS = [int(v) for v in limbs_mod.int_to_limbs(D2 % P)]
+
+
+def _padd(p, q):
+    """Complete unified addition (add-2008-hwcd-3, a=-1) on 4×NLIMBS limb
+    lists — same formula as jnp_edwards.point_add."""
+    import jax.numpy as jnp
+
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = _fmul(_fsub(Y1, X1), _fsub(Y2, X2))
+    B = _fmul(_fadd(Y1, X1), _fadd(Y2, X2))
+    d2 = [jnp.full(T1[0].shape, v, jnp.int32) for v in _D2_LIMBS]
+    C = _fmul(_fmul(T1, d2), T2)
+    Dv = _fmul_small(_fmul(Z1, Z2), 2)
+    E = _fsub(B, A)
+    Fv = _fsub(Dv, C)
+    G = _fadd(Dv, C)
+    H = _fadd(B, A)
+    return (
+        _fmul(E, Fv),
+        _fmul(G, H),
+        _fmul(Fv, G),
+        _fmul(E, H),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
+                            nwin: int = NWINDOWS,
+                            interpret: bool = False,
+                            tile=(SUBLANES, LANES),
+                            tbl_dtype="int16"):
+    """digits (B, nwin, nb, S, L) int8 (signed, d ∈ [-8, 8]),
+    points (B, 4, NLIMBS, nb, S, L) int16
+    → per-block partial window sums (B, nb, nwin, 4, NLIMBS, fS, L) int16.
+
+    `tile` is the (sublane, lane) block shape — (32, 128) on hardware;
+    interpreter-mode tests shrink it so tiny cases stay fast."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, Ln = tile
+    fS = min(FOLD_SUBLANES, S)
+    tdt = jnp.int16 if tbl_dtype == "int16" else jnp.int32
+
+    def kernel(dig_ref, pts_ref, out_ref, tbl_ref):
+        # --- signed table build: tbl[k] = [k]P, k = 0..8 -----------------
+        pt = tuple(
+            [pts_ref[0, c, l, 0].astype(jnp.int32) for l in range(NLIMBS)]
+            for c in range(4)
+        )
+        zero = jnp.zeros((S, Ln), jnp.int32)
+        one = jnp.ones((S, Ln), jnp.int32)
+        ident_pt = (
+            [zero] * NLIMBS,
+            [one] + [zero] * (NLIMBS - 1),
+            [one] + [zero] * (NLIMBS - 1),
+            [zero] * NLIMBS,
+        )
+
+        def write_tbl(k, p):
+            for c in range(4):
+                for l in range(NLIMBS):
+                    tbl_ref[k, c, l] = p[c][l].astype(tdt)
+
+        def read_tbl(k):
+            return tuple(
+                [tbl_ref[k, c, l].astype(jnp.int32) for l in range(NLIMBS)]
+                for c in range(4)
+            )
+
+        write_tbl(0, ident_pt)
+        write_tbl(1, pt)
+
+        def table_body(k, _):
+            write_tbl(k, _padd(read_tbl(k - 1), pt))
+            return 0
+
+        jax.lax.fori_loop(2, 9, table_body, 0)
+
+        # --- per-window select + in-block lane fold ----------------------
+        def window_body(w, _):
+            d = dig_ref[0, w, 0].astype(jnp.int32)  # (32, 128)
+            mag = jnp.abs(d)
+            sel = None
+            for k in range(9):
+                mask = (mag == k).astype(jnp.int32)
+                entry = read_tbl(k)
+                contrib = tuple(
+                    [mask * limb for limb in coord] for coord in entry
+                )
+                sel = contrib if sel is None else tuple(
+                    [x + y for x, y in zip(sc, cc)]
+                    for sc, cc in zip(sel, contrib)
+                )
+            # negative digits: negate X and T (free in balanced limbs)
+            sgn = jnp.where(d < 0, jnp.int32(-1), jnp.int32(1))
+            sel = (
+                [sgn * x for x in sel[0]],
+                sel[1],
+                sel[2],
+                [sgn * x for x in sel[3]],
+            )
+            # fold the sublane rows down by halving point-adds
+            s = S
+            while s > fS:
+                half = s // 2
+                lo = tuple(
+                    [x[:half] for x in coord] for coord in sel
+                )
+                hi = tuple(
+                    [x[half:] for x in coord] for coord in sel
+                )
+                sel = _padd(lo, hi)
+                s = half
+            for c in range(4):
+                for l in range(NLIMBS):
+                    out_ref[0, 0, w, c, l] = sel[c][l].astype(jnp.int16)
+            return 0
+
+        jax.lax.fori_loop(0, nwin, window_body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_batches, n_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, nwin, 1, S, Ln), lambda b, i: (b, 0, i, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 4, NLIMBS, 1, S, Ln),
+                lambda b, i: (b, 0, 0, i, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, nwin, 4, NLIMBS, fS, Ln),
+            lambda b, i: (b, i, 0, 0, 0, 0, 0),
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_batches, n_blocks, nwin, 4, NLIMBS, fS, Ln),
+            jnp.int16,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((9, 4, NLIMBS, S, Ln), tdt)
+        ],
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
+                       interpret: bool = False, tile=(SUBLANES, LANES),
+                       tbl_dtype="int16"):
+    """ONE jitted function for the whole device step: Pallas partial-sum
+    kernel + XLA fold of the per-block partials, so a multi-batch
+    verification is a single tunnel call.
+    (B, nwin, N) int8, (B, 4, NLIMBS, N) int16 → (B, 4, NLIMBS, nwin)
+    int32."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import jnp_edwards as E
+
+    S, Ln = tile
+    group = S * Ln
+    assert n_lanes % group == 0
+    n_blocks = n_lanes // group
+    kernel = _compiled_pallas_kernel(n_batches, n_blocks, nwin,
+                                     interpret=interpret, tile=tile,
+                                     tbl_dtype=tbl_dtype)
+    fS = min(FOLD_SUBLANES, S)
+
+    def pipeline(digits, points):
+        dig = digits.reshape(n_batches, nwin, n_blocks, S, Ln)
+        pts = points.reshape(
+            n_batches, 4, NLIMBS, n_blocks, S, Ln
+        )
+        part = kernel(dig, pts)  # (B, nb, nwin, 4, NLIMBS, 8, 128) int16
+        # point tensors for the XLA fold must be (4, NLIMBS, ...batch axes)
+        acc = jnp.transpose(part, (3, 4, 0, 2, 1, 5, 6)).astype(jnp.int32)
+        # (4, NLIMBS, B, nwin, nb, 8, 128): fold blocks, then the 1024 lanes
+        nb = n_blocks
+        while nb > 1:
+            half = nb // 2
+            odd = nb - 2 * half
+            folded = E.point_add(
+                acc[:, :, :, :, :half], acc[:, :, :, :, half:2 * half]
+            )
+            if odd:
+                folded = jnp.concatenate(
+                    [folded, acc[:, :, :, :, 2 * half:]], axis=4
+                )
+            acc = folded
+            nb = half + odd
+        acc = acc[:, :, :, :, 0]  # (4, NLIMBS, B, nwin, fS, Ln)
+        s = fS
+        while s > 1:
+            half = s // 2
+            acc = E.point_add(acc[..., :half, :], acc[..., half:, :])
+            s = half
+        acc = acc[..., 0, :]  # (4, NLIMBS, B, nwin, Ln)
+        g = Ln
+        while g > 1:
+            half = g // 2
+            acc = E.point_add(acc[..., :half], acc[..., half:])
+            g = half
+        return jnp.transpose(acc[..., 0], (2, 0, 1, 3))  # (B,4,NLIMBS,nwin)
+
+    return jax.jit(pipeline)
+
+
+def pallas_window_sums_many(digits, points, interpret: bool = False,
+                            tile=(SUBLANES, LANES)):
+    """Batched dispatch: digits (B, nwin, N) int8, points (B, 4, NLIMBS, N)
+    int16 numpy arrays → (B, 4, NLIMBS, nwin) device array, one device
+    call."""
+    B, nwin, N = digits.shape
+    return _compiled_pipeline(B, N, nwin, interpret=interpret, tile=tile)(
+        digits, points
+    )
+
+
+def pallas_window_sums(digits, points, interpret: bool = False,
+                       tile=(SUBLANES, LANES)):
+    """Single-batch dispatch; returns a (1, 4, NLIMBS, nwin) device
+    array."""
+    return pallas_window_sums_many(
+        digits[None], points[None], interpret=interpret, tile=tile
+    )
+
+
+def pad_lanes(n: int, group: int = GROUP) -> int:
+    """Pallas lane padding: multiple of the grid block."""
+    return max(group, -(-n // group) * group)

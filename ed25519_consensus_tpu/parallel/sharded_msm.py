@@ -77,6 +77,24 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
     return jax.jit(fn), mesh
 
 
+def _shard_pad(n: int, n_devices: int) -> int:
+    """Pad the term count so each device holds an equal power-of-two
+    shard."""
+    per_dev = 1
+    while n_devices * per_dev < max(n, 8 * n_devices):
+        per_dev <<= 1
+    return n_devices * per_dev
+
+
+def sharded_window_sums(digits, pts, n_devices: int):
+    """Dispatch pre-packed operands over the mesh; returns the replicated
+    (4, NLIMBS, nwin) window sums as a device array."""
+    kernel, _ = _compiled_sharded_kernel(
+        n_devices, digits.shape[1] // n_devices, digits.shape[0]
+    )
+    return kernel(digits, pts)
+
+
 def sharded_device_msm(scalars, points, n_devices: int | None = None,
                        shifts=None) -> Point:
     """Exact Σ[c_i]P_i sharded over `n_devices` (default: all devices).
@@ -89,13 +107,20 @@ def sharded_device_msm(scalars, points, n_devices: int | None = None,
     if not len(scalars):
         return Point(0, 1, 1, 0)
     scalars, points = msm_lib.split_terms(scalars, points, shifts)
-    # Pad the term count so each device holds an equal power-of-two shard.
-    n = len(scalars)
-    per_dev = 1
-    while n_devices * per_dev < max(n, 8 * n_devices):
-        per_dev <<= 1
-    N = n_devices * per_dev
+    N = _shard_pad(len(scalars), n_devices)
     digits, pts = msm_lib.pack_msm_operands(scalars, points, n_lanes=N)
-    kernel, _ = _compiled_sharded_kernel(n_devices, per_dev, digits.shape[0])
-    out = np.asarray(kernel(digits, pts))
+    out = np.asarray(sharded_window_sums(digits, pts, n_devices))
+    return msm_lib.combine_window_sums(out)
+
+
+def sharded_staged_msm(staged, n_devices: int | None = None) -> Point:
+    """The multi-chip MSM for a batch.StagedBatch (buffer-form staging)."""
+    import jax
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    digits, pts = staged.device_operands(
+        lambda n: _shard_pad(n, n_devices)
+    )
+    out = np.asarray(sharded_window_sums(digits, pts, n_devices))
     return msm_lib.combine_window_sums(out)

@@ -99,6 +99,22 @@ def test_batch_verify_device_backend_rejects_bad():
         bv.verify_tpu(rng=rng)
 
 
+def test_verify_many_pad_covers_split_terms():
+    """verify_many must size the common lane pad from the count INCLUDING
+    the 128-bit split-high terms (regression: 130 distinct-key sigs made
+    the packed term count overflow a pad computed from n_terms alone)."""
+    vs = []
+    for b in range(2):
+        bv = batch.Verifier()
+        for i in range(130):
+            sk = SigningKey.new(rng)
+            msg = b"pad regression %d %d" % (b, i)
+            sig = sk.sign(msg if (b, i) != (1, 7) else b"tampered")
+            bv.queue((sk.verification_key_bytes(), sig, msg))
+        vs.append(bv)
+    assert batch.verify_many(vs, rng=rng) == [True, False]
+
+
 def test_small_order_matrix_device_parity():
     """Every conformance-matrix case through the DEVICE path: batch-of-one
     verdicts must equal the host-path verdicts (all valid under ZIP215).

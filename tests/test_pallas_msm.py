@@ -1,0 +1,66 @@
+"""Host-side parity for the Pallas MSM operand format (the signed-digit
+recoding and packing feeding the Mosaic kernel).
+
+The kernel itself cannot run under this suite: tests force the CPU backend
+(conftest.py) and Mosaic interpret mode is minutes-per-case there.  Its
+hardware parity gate is tools/check_pallas_parity.py, run against the real
+TPU (the bench also asserts end-to-end verdicts through the Pallas path on
+every run)."""
+
+import random
+
+import numpy as np
+
+from ed25519_consensus_tpu.ops import limbs
+
+rng = random.Random(0x51D)
+
+
+def _digits_value(planes, col):
+    """Recombine MSB-first signed digit planes into the scalar they
+    encode."""
+    val = 0
+    for w in range(planes.shape[0]):
+        val = 16 * val + int(planes[w, col])
+    return val
+
+
+def test_signed_recode_roundtrip():
+    cases = [0, 1, 8, 9, 15, 16, 0x8888888888888888, 0x9999999999999999,
+             (1 << 128) - 1, 0xFFFFFFFFFFFFFFFF]
+    cases += [rng.randrange(1 << 128) for _ in range(64)]
+    planes = limbs.pack_scalar_windows(cases)
+    assert planes.dtype == np.int8
+    assert planes.shape == (limbs.NWINDOWS, len(cases))
+    assert int(np.abs(planes).max()) <= 8
+    for j, c in enumerate(cases):
+        assert _digits_value(planes, j) == c, hex(c)
+
+
+def test_u128_window_packing_matches_scalar_packing():
+    zs = [rng.randrange(1 << 128) for _ in range(40)] + [0, 1, (1 << 128) - 1]
+    zb = np.frombuffer(
+        b"".join(z.to_bytes(16, "little") for z in zs), dtype=np.uint8
+    ).reshape(len(zs), 16)
+    got = limbs.pack_u128_windows(zb)
+    want = limbs.pack_scalar_windows(zs)
+    assert np.array_equal(got, want)
+
+
+def test_point_packing_int16_from_raw():
+    from ed25519_consensus_tpu.ops import edwards
+    from ed25519_consensus_tpu.ops.field import P
+
+    pts = [edwards.BASEPOINT.scalar_mul(i + 1) for i in range(5)]
+    raw = np.frombuffer(
+        b"".join(
+            b"".join((c % P).to_bytes(32, "little")
+                     for c in (p.X, p.Y, p.Z, p.T))
+            for p in pts
+        ),
+        dtype=np.uint8,
+    ).reshape(len(pts), 128)
+    packed = limbs.pack_points_from_raw(raw)
+    assert packed.dtype == np.int16
+    want = limbs.pack_point_batch(pts)
+    assert np.array_equal(packed.astype(np.int32), want)
