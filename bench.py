@@ -13,9 +13,17 @@ TPU."""
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
+
+# Persistent XLA compilation cache: kernel compiles (~1-2 min through the
+# remote-compile tunnel, and occasionally flaky) are paid once per
+# lane-count, ever, instead of once per process.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/ed25519_tpu_jax"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def build_batch(config: str, rng):
@@ -82,14 +90,14 @@ def main():
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--pipeline", type=int, default=None,
                     help="batches in flight per run (device only; "
-                         "default 4).  Steady-state throughput: host "
-                         "staging of batch i+1 overlaps device compute of "
-                         "batch i.")
+                         "default 16).  Steady-state throughput: host "
+                         "staging of chunk i+1 overlaps device compute of "
+                         "chunk i (batch.verify_many).")
     args = ap.parse_args()
     if args.backend != "device" and args.pipeline not in (None, 1):
         ap.error("--pipeline requires --backend device")
     depth = args.pipeline if args.pipeline is not None else (
-        4 if args.backend == "device" else 1)
+        16 if args.backend == "device" else 1)
     if depth < 1:
         ap.error("--pipeline must be ≥ 1")
 
@@ -101,11 +109,26 @@ def main():
           f"in {time.time()-t0:.1f}s", file=sys.stderr)
 
     # Warmup (compiles the kernel for this batch's padded lane count).
+    # The remote-compile tunnel is occasionally flaky: retry once, then
+    # fall back to the host backend rather than failing the bench.
+    backend = args.backend
     t0 = time.time()
-    rebuild_fresh(bv).verify(rng=rng, backend=args.backend)
-    print(f"# warmup (compile+run): {time.time()-t0:.1f}s", file=sys.stderr)
+    for attempt in (1, 2, 3):
+        try:
+            rebuild_fresh(bv).verify(rng=rng, backend=backend)
+            break
+        except Exception as e:  # noqa: BLE001 - resilience path
+            print(f"# warmup attempt {attempt} on backend={backend} "
+                  f"failed: {type(e).__name__}: {str(e)[:120]}",
+                  file=sys.stderr)
+            if attempt == 2 and backend != "host":
+                backend = "host"
+            elif attempt == 3:
+                raise
+    print(f"# warmup (compile+run): {time.time()-t0:.1f}s "
+          f"backend={backend}", file=sys.stderr)
 
-    if args.backend == "device" and depth > 1:
+    if backend == "device" and depth > 1:
         # warm the batched kernel too
         from ed25519_consensus_tpu import batch as batch_mod
 
@@ -116,8 +139,9 @@ def main():
     best = float("inf")
     for _ in range(args.runs):
         t0 = time.time()
-        if args.backend == "device" and depth > 1:
-            # Steady-state throughput: `depth` batches, ONE device call.
+        if backend == "device" and depth > 1:
+            # Steady-state throughput: `depth` batches, chunked device
+            # calls with host staging overlapping device compute.
             from ed25519_consensus_tpu import batch as batch_mod
 
             verdicts = batch_mod.verify_many(
@@ -125,14 +149,14 @@ def main():
             )
             assert all(verdicts), "bench batch must verify"
         else:
-            rebuild_fresh(bv).verify(rng=rng, backend=args.backend)
+            rebuild_fresh(bv).verify(rng=rng, backend=backend)
         dt = (time.time() - t0) / depth
         best = min(best, dt)
         print(f"# run: {dt:.3f}s/batch -> {n/dt:.0f} sigs/s", file=sys.stderr)
 
     value = n / best
     print(json.dumps({
-        "metric": f"batch_verify_sigs_per_sec[{args.config},{args.backend}]",
+        "metric": f"batch_verify_sigs_per_sec[{args.config},{backend}]",
         "value": round(value, 1),
         "unit": "sigs/sec/chip",
         "vs_baseline": round(value / 200_000, 4),

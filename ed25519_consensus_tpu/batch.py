@@ -373,39 +373,57 @@ class Verifier:
         self.verify(rng=rng, backend="device")
 
 
-def verify_many(verifiers, rng=None) -> "list[bool]":
-    """Verify MANY independent batches in ONE device call.
+def verify_many(verifiers, rng=None, chunk: int = 8) -> "list[bool]":
+    """Verify MANY independent batches with chunked, double-buffered
+    device calls.
 
     On a remote-attached TPU the per-call round-trip dominates a batch's
-    device cost, so the steady-state throughput path stacks the packed
-    operands of every batch (padded to a common lane count) behind a single
-    batched kernel launch and a single result fetch.  Returns a verdict per
-    verifier (True = every queued signature valid); each verdict is decided
-    by the same exact host math as `verify` (staging rejections included —
-    a batch that fails host staging is simply verdict False here).
-    """
+    device cost, so batches are stacked `chunk` at a time behind one
+    batched kernel launch — and because the launches are async, host
+    staging of chunk i+1 overlaps device compute of chunk i (the two are
+    the same order of magnitude, so the overlap is ~2× steady-state
+    throughput).  Returns a verdict per verifier (True = every queued
+    signature valid); each verdict is decided by the same exact host math
+    as `verify` (staging rejections included — a batch that fails host
+    staging is simply verdict False here)."""
     from .ops import msm
 
     verifiers = list(verifiers)
     verdicts = [False] * len(verifiers)
-    staged_list, idxs = [], []
-    for i, v in enumerate(verifiers):
-        try:
-            staged_list.append(v._stage(rng))
-            idxs.append(i)
-        except InvalidSignature:
-            pass  # malformed input: verdict stays False
-    if not staged_list:
-        return verdicts
-    # Pack all batches to one common lane count and stack.
-    pad = max(msm.preferred_pad(s.n_device_terms) for s in staged_list)
-    ops = [s.device_operands(lambda n: pad) for s in staged_list]
-    digits = np.stack([d for d, _ in ops])
-    pts = np.stack([p for _, p in ops])
-    out = np.asarray(msm.dispatch_window_sums_many(digits, pts))
-    for j, i in enumerate(idxs):
-        check = msm.combine_window_sums(out[j])
-        verdicts[i] = check.mul_by_cofactor().is_identity()
+
+    def stage_chunk(vs_idx):
+        staged, idxs = [], []
+        for i in vs_idx:
+            try:
+                staged.append(verifiers[i]._stage(rng))
+                idxs.append(i)
+            except InvalidSignature:
+                pass  # malformed input: verdict stays False
+        if not staged:
+            return None
+        pad = max(msm.preferred_pad(s.n_device_terms) for s in staged)
+        ops = [s.device_operands(lambda n: pad) for s in staged]
+        digits = np.stack([d for d, _ in ops])
+        pts = np.stack([p for _, p in ops])
+        return idxs, msm.dispatch_window_sums_many(digits, pts)
+
+    def collect(pending):
+        if pending is None:
+            return
+        idxs, out_dev = pending
+        out = np.asarray(out_dev)
+        for j, i in enumerate(idxs):
+            check = msm.combine_window_sums(out[j])
+            verdicts[i] = check.mul_by_cofactor().is_identity()
+
+    chunks = [list(range(k, min(k + chunk, len(verifiers))))
+              for k in range(0, len(verifiers), chunk)]
+    in_flight = None
+    for ch in chunks:
+        pending = stage_chunk(ch)  # overlaps the previous chunk's device run
+        collect(in_flight)
+        in_flight = pending
+    collect(in_flight)
     return verdicts
 
 
