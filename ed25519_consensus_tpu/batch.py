@@ -373,32 +373,49 @@ class Verifier:
         self.verify(rng=rng, backend="device")
 
 
-def verify_many(verifiers, rng=None, chunk: int = 8) -> "list[bool]":
+def verify_many(verifiers, rng=None, chunk: int = 8,
+                hybrid: bool = True) -> "list[bool]":
     """Verify MANY independent batches with chunked, double-buffered
-    device calls.
+    device calls plus an opportunistic host lane.
 
     On a remote-attached TPU the per-call round-trip dominates a batch's
     device cost, so batches are stacked `chunk` at a time behind one
     batched kernel launch — and because the launches are async, host
-    staging of chunk i+1 overlaps device compute of chunk i (the two are
-    the same order of magnitude, so the overlap is ~2× steady-state
-    throughput).  Returns a verdict per verifier (True = every queued
-    signature valid); each verdict is decided by the same exact host math
-    as `verify` (staging rejections included — a batch that fails host
-    staging is simply verdict False here)."""
+    staging of chunk i+1 overlaps device compute of chunk i.  While a
+    device chunk is still in flight after the next chunk is staged, the
+    otherwise-idle host core verifies further batches end-to-end with the
+    native C++ MSM (`hybrid`), so host and device throughput ADD.
+
+    Returns a verdict per verifier (True = every queued signature valid);
+    each verdict is decided by the same exact host math as `verify`
+    (staging rejections included — a batch that fails host staging is
+    simply verdict False here)."""
     from .ops import msm
 
     verifiers = list(verifiers)
     verdicts = [False] * len(verifiers)
+    remaining = list(range(len(verifiers)))  # tail = host-lane candidates
+
+    def stage_one(i):
+        try:
+            return verifiers[i]._stage(rng)
+        except InvalidSignature:
+            return None  # malformed input: verdict stays False
+
+    def host_verify_one(i):
+        staged = stage_one(i)
+        if staged is None:
+            return
+        check = staged.host_msm()
+        verdicts[i] = check.mul_by_cofactor().is_identity()
 
     def stage_chunk(vs_idx):
         staged, idxs = [], []
         for i in vs_idx:
-            try:
-                staged.append(verifiers[i]._stage(rng))
+            s = stage_one(i)
+            if s is not None:
+                staged.append(s)
                 idxs.append(i)
-            except InvalidSignature:
-                pass  # malformed input: verdict stays False
         if not staged:
             return None
         pad = max(msm.preferred_pad(s.n_device_terms) for s in staged)
@@ -406,6 +423,14 @@ def verify_many(verifiers, rng=None, chunk: int = 8) -> "list[bool]":
         digits = np.stack([d for d, _ in ops])
         pts = np.stack([p for _, p in ops])
         return idxs, msm.dispatch_window_sums_many(digits, pts)
+
+    def device_done(pending) -> bool:
+        if pending is None:
+            return True
+        try:
+            return pending[1].is_ready()
+        except AttributeError:
+            return True
 
     def collect(pending):
         if pending is None:
@@ -416,11 +441,16 @@ def verify_many(verifiers, rng=None, chunk: int = 8) -> "list[bool]":
             check = msm.combine_window_sums(out[j])
             verdicts[i] = check.mul_by_cofactor().is_identity()
 
-    chunks = [list(range(k, min(k + chunk, len(verifiers))))
-              for k in range(0, len(verifiers), chunk)]
     in_flight = None
-    for ch in chunks:
+    while remaining:
+        ch = remaining[:chunk]
+        del remaining[:chunk]
         pending = stage_chunk(ch)  # overlaps the previous chunk's device run
+        # Device still busy with the previous chunk?  Feed the host lane
+        # from the tail instead of blocking.
+        while (hybrid and remaining and in_flight is not None
+               and not device_done(in_flight)):
+            host_verify_one(remaining.pop())
         collect(in_flight)
         in_flight = pending
     collect(in_flight)
