@@ -441,19 +441,24 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             check = msm.combine_window_sums(out[j])
             verdicts[i] = check.mul_by_cofactor().is_identity()
 
-    in_flight = None
-    while remaining:
+    # Work-stealing pipeline: the device takes chunks from the front
+    # (keeping up to two launches queued so it never starves while the
+    # host stages), and the host lane eats batches from the tail whenever
+    # the device is busy — so a degraded device link degrades throughput
+    # to the host's native rate instead of stalling the pipeline.
+    def take_chunk():
         ch = remaining[:chunk]
         del remaining[:chunk]
-        pending = stage_chunk(ch)  # overlaps the previous chunk's device run
-        # Device still busy with the previous chunk?  Feed the host lane
-        # from the tail instead of blocking.
-        while (hybrid and remaining and in_flight is not None
+        return stage_chunk(ch)
+
+    in_flight = take_chunk() if remaining else None
+    while in_flight is not None:
+        nxt = take_chunk() if remaining else None  # queue the next launch
+        while (hybrid and remaining
                and not device_done(in_flight)):
-            host_verify_one(remaining.pop())
+            host_verify_one(remaining.pop())  # steal from the tail
         collect(in_flight)
-        in_flight = pending
-    collect(in_flight)
+        in_flight = nxt
     return verdicts
 
 

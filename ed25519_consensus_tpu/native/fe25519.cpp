@@ -14,6 +14,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <immintrin.h>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
@@ -271,6 +272,305 @@ inline void ge_double(ge &r, const ge &p) {
     fe_mul(r.T, e, h);
 }
 
+// ---- 8-way field arithmetic on AVX512-IFMA ------------------------------
+//
+// The batch-staging hot spot is ZIP215 decompression: one ~252-squaring
+// inverse-sqrt chain per point, inherently scalar per point but perfectly
+// data-parallel ACROSS points.  `vpmadd52{l,h}uq` multiply-accumulates the
+// low/high 52 bits of 52-bit products over 8 u64 lanes, which matches the
+// radix-2^51 representation: the product column at radix position i+j gets
+// lo52(a_i·b_j), and position i+j+1 gets 2·hi52(a_i·b_j) (since
+// 2^52 = 2·2^51).  Bounds: limbs stay < 2^52 between muls; column sums
+// ≤ 5·2^52 + 2·5·2^51 < 2^55.4; the ×19 fold of columns 5..9 keeps
+// everything < 2^60 « 2^64.  Runtime-dispatched: the scalar path remains
+// the fallback (and the parity oracle in tests/test_native.py).
+
+#if defined(__x86_64__)
+#define IFMA_TARGET \
+    __attribute__((target("avx512f,avx512dq,avx512bw,avx512vl,avx512ifma")))
+
+namespace ifma {
+
+struct fe8 {
+    __m512i v[5];  // 8 field elements, radix-2^51 limbs on u64 lanes
+};
+
+IFMA_TARGET static inline __m512i mul19(__m512i x) {
+    // 19x = 16x + 2x + x
+    return _mm512_add_epi64(
+        _mm512_add_epi64(_mm512_slli_epi64(x, 4), _mm512_slli_epi64(x, 1)),
+        x);
+}
+
+IFMA_TARGET static inline void fe8_carry(fe8 &h) {
+    const __m512i mask = _mm512_set1_epi64(MASK51);
+    for (int pass = 0; pass < 2; pass++) {
+        __m512i c;
+        c = _mm512_srli_epi64(h.v[0], 51);
+        h.v[0] = _mm512_and_si512(h.v[0], mask);
+        h.v[1] = _mm512_add_epi64(h.v[1], c);
+        c = _mm512_srli_epi64(h.v[1], 51);
+        h.v[1] = _mm512_and_si512(h.v[1], mask);
+        h.v[2] = _mm512_add_epi64(h.v[2], c);
+        c = _mm512_srli_epi64(h.v[2], 51);
+        h.v[2] = _mm512_and_si512(h.v[2], mask);
+        h.v[3] = _mm512_add_epi64(h.v[3], c);
+        c = _mm512_srli_epi64(h.v[3], 51);
+        h.v[3] = _mm512_and_si512(h.v[3], mask);
+        h.v[4] = _mm512_add_epi64(h.v[4], c);
+        c = _mm512_srli_epi64(h.v[4], 51);
+        h.v[4] = _mm512_and_si512(h.v[4], mask);
+        h.v[0] = _mm512_add_epi64(h.v[0], mul19(c));
+    }
+}
+
+IFMA_TARGET static void fe8_mul(fe8 &out, const fe8 &a, const fe8 &b) {
+    __m512i zl[10], zh[10];
+    const __m512i zero = _mm512_setzero_si512();
+    for (int k = 0; k < 10; k++) {
+        zl[k] = zero;
+        zh[k] = zero;
+    }
+    for (int i = 0; i < 5; i++) {
+        for (int j = 0; j < 5; j++) {
+            zl[i + j] = _mm512_madd52lo_epu64(zl[i + j], a.v[i], b.v[j]);
+            zh[i + j + 1] =
+                _mm512_madd52hi_epu64(zh[i + j + 1], a.v[i], b.v[j]);
+        }
+    }
+    __m512i col[10];
+    for (int k = 0; k < 10; k++)
+        col[k] = _mm512_add_epi64(zl[k], _mm512_slli_epi64(zh[k], 1));
+    // fold radix positions 5..9: 2^255 ≡ 19 (mod p)
+    fe8 h;
+    for (int k = 0; k < 5; k++)
+        h.v[k] = _mm512_add_epi64(col[k], mul19(col[k + 5]));
+    fe8_carry(h);
+    out = h;
+}
+
+IFMA_TARGET static inline void fe8_sq(fe8 &out, const fe8 &a) {
+    fe8_mul(out, a, a);
+}
+
+IFMA_TARGET static inline void fe8_add(fe8 &out, const fe8 &a,
+                                       const fe8 &b) {
+    for (int i = 0; i < 5; i++)
+        out.v[i] = _mm512_add_epi64(a.v[i], b.v[i]);
+    fe8_carry(out);
+}
+
+// out = a - b, using a + 2p - b to stay nonnegative (inputs carried).
+IFMA_TARGET static inline void fe8_sub(fe8 &out, const fe8 &a,
+                                       const fe8 &b) {
+    const __m512i p2_0 = _mm512_set1_epi64(0xFFFFFFFFFFFDAULL * 2);
+    const __m512i p2_i = _mm512_set1_epi64(0xFFFFFFFFFFFFEULL * 2);
+    out.v[0] = _mm512_sub_epi64(_mm512_add_epi64(a.v[0], p2_0), b.v[0]);
+    for (int i = 1; i < 5; i++)
+        out.v[i] = _mm512_sub_epi64(_mm512_add_epi64(a.v[i], p2_i), b.v[i]);
+    fe8_carry(out);
+}
+
+IFMA_TARGET static inline void fe8_splat(fe8 &out, const fe &s) {
+    for (int i = 0; i < 5; i++)
+        out.v[i] = _mm512_set1_epi64(s.v[i]);
+}
+
+// z^(2^252 - 3) — same addition chain as the scalar fe_pow22523.
+IFMA_TARGET static void fe8_pow22523(fe8 &out, const fe8 &z) {
+    fe8 t0, t1, t2;
+    fe8_sq(t0, z);
+    fe8_sq(t1, t0);
+    fe8_sq(t1, t1);
+    fe8_mul(t1, t1, z);
+    fe8_mul(t0, t0, t1);
+    fe8_sq(t0, t0);
+    fe8_mul(t0, t1, t0);
+    fe8_sq(t1, t0);
+    for (int i = 1; i < 5; i++) fe8_sq(t1, t1);
+    fe8_mul(t0, t1, t0);
+    fe8_sq(t1, t0);
+    for (int i = 1; i < 10; i++) fe8_sq(t1, t1);
+    fe8_mul(t1, t1, t0);
+    fe8_sq(t2, t1);
+    for (int i = 1; i < 20; i++) fe8_sq(t2, t2);
+    fe8_mul(t1, t2, t1);
+    for (int i = 0; i < 10; i++) fe8_sq(t1, t1);
+    fe8_mul(t0, t1, t0);
+    fe8_sq(t1, t0);
+    for (int i = 1; i < 50; i++) fe8_sq(t1, t1);
+    fe8_mul(t1, t1, t0);
+    fe8_sq(t2, t1);
+    for (int i = 1; i < 100; i++) fe8_sq(t2, t2);
+    fe8_mul(t1, t2, t1);
+    for (int i = 0; i < 50; i++) fe8_sq(t1, t1);
+    fe8_mul(t0, t1, t0);
+    fe8_sq(t0, t0);
+    fe8_sq(t0, t0);
+    fe8_mul(out, t0, z);
+}
+
+// Canonicalize (freeze) in place so lanes can be compared bitwise.
+IFMA_TARGET static void fe8_freeze(fe8 &h) {
+    const __m512i mask = _mm512_set1_epi64(MASK51);
+    fe8_carry(h);
+    // q = carry-out of (h + 19) across all limbs — 1 iff h >= p
+    __m512i q = _mm512_srli_epi64(
+        _mm512_add_epi64(h.v[0], _mm512_set1_epi64(19)), 51);
+    for (int i = 1; i < 5; i++)
+        q = _mm512_srli_epi64(_mm512_add_epi64(h.v[i], q), 51);
+    h.v[0] = _mm512_add_epi64(h.v[0], mul19(q));
+    __m512i c;
+    for (int i = 0; i < 4; i++) {
+        c = _mm512_srli_epi64(h.v[i], 51);
+        h.v[i] = _mm512_and_si512(h.v[i], mask);
+        h.v[i + 1] = _mm512_add_epi64(h.v[i + 1], c);
+    }
+    h.v[4] = _mm512_and_si512(h.v[4], mask);
+}
+
+// lane mask: 1 where a == b as field elements (inputs need not be frozen)
+IFMA_TARGET static __mmask8 fe8_eq_mask(const fe8 &a, const fe8 &b) {
+    fe8 d;
+    fe8_sub(d, a, b);
+    fe8_freeze(d);
+    const __m512i zero = _mm512_setzero_si512();
+    __mmask8 m = _mm512_cmpeq_epu64_mask(d.v[0], zero);
+    for (int i = 1; i < 5; i++)
+        m &= _mm512_cmpeq_epu64_mask(d.v[i], zero);
+    return m;
+}
+
+IFMA_TARGET static inline void fe8_neg(fe8 &out, const fe8 &a) {
+    fe8 zero;
+    for (int i = 0; i < 5; i++) zero.v[i] = _mm512_setzero_si512();
+    fe8_sub(out, zero, a);
+}
+
+// Conditionally negate lanes selected by m.
+IFMA_TARGET static inline void fe8_cneg(fe8 &h, __mmask8 m) {
+    fe8 n;
+    fe8_neg(n, h);
+    for (int i = 0; i < 5; i++)
+        h.v[i] = _mm512_mask_blend_epi64(m, h.v[i], n.v[i]);
+}
+
+// Batched ZIP215 decompression of 8 encodings; bit-identical to the
+// scalar loop in zip215_decompress_batch.
+IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
+                                    uint8_t *ok) {
+    // transpose: load each lane's y via the scalar frombytes
+    fe ys[8];
+    int signs[8];
+    for (int l = 0; l < 8; l++) {
+        fe_frombytes(ys[l], enc + 32 * l);
+        signs[l] = enc[32 * l + 31] >> 7;
+    }
+    fe8 y;
+    for (int i = 0; i < 5; i++)
+        y.v[i] = _mm512_set_epi64(ys[7].v[i], ys[6].v[i], ys[5].v[i],
+                                  ys[4].v[i], ys[3].v[i], ys[2].v[i],
+                                  ys[1].v[i], ys[0].v[i]);
+    __mmask8 sign_m = 0;
+    for (int l = 0; l < 8; l++) sign_m |= (signs[l] & 1) << l;
+
+    fe8 one, d8, sqrtm1_8;
+    fe one_s;
+    fe_one(one_s);
+    fe8_splat(one, one_s);
+    fe8_splat(d8, FE_D);
+    fe8_splat(sqrtm1_8, FE_SQRTM1);
+
+    fe8 yy, u, v, v3, v7, t0, t1, r, chk;
+    fe8_sq(yy, y);
+    fe8_sub(u, yy, one);            // u = y^2 - 1
+    fe8_mul(v, yy, d8);
+    fe8_add(v, v, one);             // v = d y^2 + 1
+    fe8_sq(v3, v);
+    fe8_mul(v3, v3, v);             // v^3
+    fe8_sq(v7, v3);
+    fe8_mul(v7, v7, v);             // v^7
+    fe8_mul(t0, u, v7);
+    fe8_pow22523(t1, t0);           // (u v^7)^((p-5)/8)
+    fe8_mul(r, u, v3);
+    fe8_mul(r, r, t1);              // candidate root
+
+    fe8_sq(chk, r);
+    fe8_mul(chk, chk, v);           // v r^2 — should be ±u
+    __mmask8 direct = fe8_eq_mask(chk, u);
+    fe8 mu;
+    fe8_neg(mu, u);
+    __mmask8 flip = fe8_eq_mask(chk, mu) & ~direct;
+    __mmask8 good = direct | flip;
+    // lanes needing the sqrt(-1) fixup
+    fe8 r_fix;
+    fe8_mul(r_fix, r, sqrtm1_8);
+    for (int i = 0; i < 5; i++)
+        r.v[i] = _mm512_mask_blend_epi64(flip, r.v[i], r_fix.v[i]);
+
+    // choose the even root, then apply the encoding's sign bit
+    fe8_freeze(r);
+    __mmask8 odd = 0;
+    {
+        const __m512i one64 = _mm512_set1_epi64(1);
+        odd = _mm512_cmpeq_epu64_mask(
+            _mm512_and_si512(r.v[0], one64), one64);
+    }
+    fe8_cneg(r, odd);               // even root
+    fe8_cneg(r, sign_m);            // sign bit (x = 0 allowed per ZIP215)
+
+    fe8 t;
+    fe8_mul(t, r, y);
+
+    // store per lane (canonical bytes)
+    fe8_freeze(r);
+    fe8 yf = y;
+    fe8_freeze(yf);
+    fe8_freeze(t);
+    alignas(64) u64 rl[5][8], yl[5][8], tl[5][8];
+    for (int i = 0; i < 5; i++) {
+        _mm512_store_si512((__m512i *)rl[i], r.v[i]);
+        _mm512_store_si512((__m512i *)yl[i], yf.v[i]);
+        _mm512_store_si512((__m512i *)tl[i], t.v[i]);
+    }
+    for (int l = 0; l < 8; l++) {
+        uint8_t *o = out + 128 * l;
+        if (!((good >> l) & 1)) {
+            ok[l] = 0;
+            memset(o, 0, 128);
+            continue;
+        }
+        fe rr, yy1, tt;
+        for (int i = 0; i < 5; i++) {
+            rr.v[i] = rl[i][l];
+            yy1.v[i] = yl[i][l];
+            tt.v[i] = tl[i][l];
+        }
+        fe_tobytes(o, rr);
+        fe_tobytes(o + 32, yy1);
+        fe one_l;
+        fe_one(one_l);
+        fe_tobytes(o + 64, one_l);
+        fe_tobytes(o + 96, tt);
+        ok[l] = 1;
+    }
+}
+
+}  // namespace ifma
+
+static bool ifma_available() {
+    static int avail = -1;
+    if (avail < 0)
+        avail = __builtin_cpu_supports("avx512ifma") &&
+                __builtin_cpu_supports("avx512dq") &&
+                __builtin_cpu_supports("avx512vl") &&
+                __builtin_cpu_supports("avx512bw");
+    return avail == 1;
+}
+#else
+static bool ifma_available() { return false; }
+#endif  // __x86_64__
+
 }  // namespace
 
 extern "C" {
@@ -351,7 +651,16 @@ int zip215_check_prehashed(const uint8_t *minusA128, const uint8_t *R128,
 //   ok:        n bytes — 1 if the encoding decompressed, else 0
 void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
                              uint8_t *out, uint8_t *ok) {
-    for (uint64_t i = 0; i < n; i++) {
+    uint64_t i0 = 0;
+#if defined(__x86_64__)
+    if (ifma_available()) {
+        // 8-way AVX512-IFMA main loop; scalar tail below.
+        for (; i0 + 8 <= n; i0 += 8)
+            ifma::decompress8(encodings + 32 * i0, out + 128 * i0,
+                              ok + i0);
+    }
+#endif
+    for (uint64_t i = i0; i < n; i++) {
         const uint8_t *enc = encodings + 32 * i;
         uint8_t *o = out + 128 * i;
         int sign = enc[31] >> 7;
