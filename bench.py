@@ -49,6 +49,16 @@ def build_batch(config: str, rng):
             sk = keys[i % 64]
             msg = b"zcash-tx-%d" % i
             bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    elif config == "pod100k":
+        # Large-batch config toward the 1M-sig pod case (BASELINE.json
+        # config 5): 100k sigs as ten 10k sub-batches through verify_many
+        # (the driver's multi-chip dry run separately validates the
+        # sharded path; a single tunneled chip verifies the stream).
+        keys = [SigningKey.new(rng) for _ in range(256)]
+        for i in range(100_000):
+            sk = keys[i % 256]
+            msg = b"pod-tx-%d" % i
+            bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
     elif config == "adversarial":
         # small-order/non-canonical (valid under ZIP215) + random valid sigs
         from ed25519_consensus_tpu import Signature
@@ -80,11 +90,72 @@ def rebuild_fresh(bv):
     return nv
 
 
+def sweep(backend: str):
+    """Mirror the reference criterion bench grid (reference
+    benches/bench.rs:26-70): batch sizes 8..64 step 8 × three modes —
+    unbatched (per-sig verify), batch with distinct keys, batch with one
+    shared key — throughput in signatures/second.  Empty-ish message, host
+    wall clock, best of 3."""
+    from ed25519_consensus_tpu import SigningKey, batch
+
+    rng = random.Random(0xC0FFEE)
+    msg = b"ed25519consensus"
+    rows = []
+    for n in range(8, 65, 8):
+        sks = [SigningKey.new(rng) for _ in range(n)]
+        shared = SigningKey.new(rng)
+        modes = {}
+
+        items_distinct = [(sk.verification_key_bytes(), sk.sign(msg), msg)
+                          for sk in sks]
+        items_same = [(shared.verification_key_bytes(), shared.sign(msg),
+                       msg) for _ in range(n)]
+
+        def best(run):
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                run()
+                ts.append(time.perf_counter() - t0)
+            return n / min(ts)
+
+        def unbatched():
+            for vkb, sig, m in items_distinct:
+                batch.Item.new(vkb, sig, m).verify_single()
+
+        def batched(items):
+            bv = batch.Verifier()
+            for it in items:
+                bv.queue(it)
+            bv.verify(rng=rng, backend=backend)
+
+        # warm any kernel compiles outside the timed region
+        batched(items_distinct)
+        modes["unbatched"] = best(unbatched)
+        modes["batch_distinct"] = best(lambda: batched(items_distinct))
+        modes["batch_same_key"] = best(lambda: batched(items_same))
+        rows.append((n, modes))
+        print(f"# n={n:3d}  unbatched {modes['unbatched']:8.0f}/s   "
+              f"distinct {modes['batch_distinct']:8.0f}/s   "
+              f"same-key {modes['batch_same_key']:8.0f}/s",
+              file=sys.stderr)
+    n32 = dict(rows)[32]
+    print(json.dumps({
+        "metric": f"sweep_batch32_distinct_sigs_per_sec[{backend}]",
+        "value": round(n32["batch_distinct"], 1),
+        "unit": "sigs/sec/chip",
+        "vs_baseline": round(n32["batch_distinct"] / 200_000, 4),
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="zcash10k",
                     choices=["bench32", "cometbft128", "zcash10k",
-                             "adversarial"])
+                             "pod100k", "adversarial"])
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the reference criterion grid (sizes 8..64, "
+                         "3 modes) instead of a single config")
     ap.add_argument("--backend", default="device",
                     choices=["device", "host", "sharded"])
     ap.add_argument("--runs", type=int, default=3)
@@ -94,6 +165,9 @@ def main():
                          "staging of chunk i+1 overlaps device compute of "
                          "chunk i (batch.verify_many).")
     args = ap.parse_args()
+    if args.sweep:
+        sweep(args.backend)
+        return
     if args.backend != "device" and args.pipeline not in (None, 1):
         ap.error("--pipeline requires --backend device")
     depth = args.pipeline if args.pipeline is not None else (

@@ -325,10 +325,15 @@ class Verifier:
                     "device MSM backend unavailable: " + str(e)
                 ) from e
             with metrics.stage("msm"):
-                digits, pts = staged.device_operands(msm.preferred_pad)
-                check = msm.PendingMSM(
-                    msm.dispatch_window_sums(digits, pts)
-                ).result()
+                try:
+                    digits, pts = staged.device_operands(msm.preferred_pad)
+                    check = msm.PendingMSM(
+                        msm.dispatch_window_sums(digits, pts)
+                    ).result()
+                except ImportError as e:
+                    raise NotImplementedError(
+                        "device MSM backend unavailable: " + str(e)
+                    ) from e
         elif backend == "sharded":
             try:
                 from .parallel import sharded_msm

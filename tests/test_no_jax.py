@@ -1,0 +1,67 @@
+"""Environment-independence: the consensus core (keygen, signing, single
+verification, host batch verification) must work with NO accelerator stack
+at all — the analog of the reference's `no_std` cross-build CI job
+(reference .github/workflows/main.yml:50-64, src/lib.rs:4-7), which proves
+the core is usable outside a full runtime.
+
+Runs in a subprocess with an import hook that hard-blocks `jax`."""
+
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import sys
+
+class BlockJax:
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError(name + " is blocked for this test")
+
+sys.meta_path.insert(0, BlockJax())
+
+import random
+from ed25519_consensus_tpu import (InvalidSignature, Signature, SigningKey,
+                                   VerificationKey, batch)
+
+rng = random.Random(7)
+sk = SigningKey.new(rng)
+sig = sk.sign(b"core without jax")
+sk.verification_key().verify(sig, b"core without jax")
+
+# wire round-trip
+vk = VerificationKey.from_bytes(bytes(sk.verification_key_bytes()))
+vk.verify(Signature.from_bytes(bytes(sig)), b"core without jax")
+
+# host batch path
+bv = batch.Verifier()
+for i in range(8):
+    s = SigningKey.new(rng)
+    m = b"msg %d" % i
+    bv.queue((s.verification_key_bytes(), s.sign(m), m))
+bv.verify(rng=rng, backend="host")
+
+# device backend must fail CLEANLY (NotImplementedError), not crash
+bv2 = batch.Verifier()
+bv2.queue((sk.verification_key_bytes(), sig, b"core without jax"))
+try:
+    bv2.verify(rng=rng, backend="device")
+except NotImplementedError:
+    pass
+except InvalidSignature:
+    raise SystemExit("device backend gave a VERDICT without jax")
+else:
+    raise SystemExit("device backend silently succeeded without jax")
+
+print("OK")
+"""
+
+
+def test_core_works_without_jax():
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip().endswith("OK"), proc.stdout
