@@ -378,6 +378,118 @@ class Verifier:
         self.verify(rng=rng, backend="device")
 
 
+# Device health: after a chunk misses its deadline, skip the device lane
+# entirely until this monotonic time (a seized tunnel can block even
+# launches for tens of seconds — retrying it every call is ruinous).
+_device_cooldown_until = [0.0]
+_device_lane_stuck = [False]
+
+_PENDING = object()
+
+# All device-side calls from every lane go through this lock: the PJRT
+# client must never be entered concurrently, including by a worker that was
+# abandoned mid-stall and later wakes up.
+_DEVICE_CALL_LOCK = None
+
+
+class _DeviceLane:
+    """The device lane: ONE worker thread serializing every device call
+    (launch + blocking fetch).  verify_many submits pre-packed chunk
+    operands and polls for results; a lane whose worker is stuck inside a
+    seized tunnel is abandoned (the thread is left to die with the
+    process) and a fresh lane is created after the health cooldown."""
+
+    _instance = None
+
+    @classmethod
+    def get(cls) -> "_DeviceLane":
+        if cls._instance is None or not cls._instance.healthy():
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        import queue
+        import threading
+
+        global _DEVICE_CALL_LOCK
+        if _DEVICE_CALL_LOCK is None:
+            _DEVICE_CALL_LOCK = threading.Lock()
+        self._q = queue.Queue()
+        self._results = {}
+        self._discarded = set()
+        self._cv = threading.Condition()
+        self._next_id = 0
+        self._abandoned = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ed25519-device-lane"
+        )
+        self._thread.start()
+
+    def healthy(self) -> bool:
+        return self._thread.is_alive() and not self._abandoned
+
+    def submit(self, digits, pts) -> int:
+        cid = self._next_id
+        self._next_id += 1
+        self._q.put((cid, digits, pts))
+        return cid
+
+    def discard(self, cid: int) -> None:
+        """Caller no longer wants this result (it decided on the host);
+        drop it on arrival instead of leaking it."""
+        with self._cv:
+            if cid in self._results:
+                del self._results[cid]
+            else:
+                self._discarded.add(cid)
+
+    def wait(self, cid: int, timeout: float):
+        """Result array, None (device error), or _PENDING on timeout."""
+        import time as _time
+
+        end = _time.monotonic() + timeout
+        with self._cv:
+            while cid not in self._results:
+                left = end - _time.monotonic()
+                if left <= 0:
+                    return (self._results.pop(cid)
+                            if cid in self._results else _PENDING)
+                self._cv.wait(left)
+            return self._results.pop(cid)
+
+    def abandon(self) -> None:
+        self._abandoned = True
+        _device_lane_stuck[0] = True
+        type(self)._instance = None
+
+    def _run(self):
+        from .ops import msm as _msm
+
+        while True:
+            cid, digits, pts = self._q.get()
+            try:
+                with _DEVICE_CALL_LOCK:
+                    out = np.asarray(
+                        _msm.dispatch_window_sums_many(digits, pts)
+                    )
+            except Exception:  # device error: caller decides on host
+                out = None
+            with self._cv:
+                if cid in self._discarded:
+                    self._discarded.discard(cid)
+                else:
+                    self._results[cid] = out
+                self._cv.notify_all()
+
+
+def device_lane_stuck() -> bool:
+    """True if any device-lane worker was ever abandoned mid-call.  A
+    stuck worker may be blocked inside the accelerator runtime; callers
+    that are about to exit the process should prefer os._exit to avoid
+    crashing in native teardown."""
+    return _device_lane_stuck[0]
+
+
 def verify_many(verifiers, rng=None, chunk: int = 8,
                 hybrid: bool = True) -> "list[bool]":
     """Verify MANY independent batches with chunked, double-buffered
@@ -395,6 +507,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     each verdict is decided by the same exact host math as `verify`
     (staging rejections included — a batch that fails host staging is
     simply verdict False here)."""
+    import time as _time
+
     from .ops import msm
 
     verifiers = list(verifiers)
@@ -407,12 +521,21 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         except InvalidSignature:
             return None  # malformed input: verdict stays False
 
+    decided = bytearray(len(verifiers))  # first lane to decide wins
+    _host_times = []
+
     def host_verify_one(i):
+        if decided[i]:
+            return
+        decided[i] = 1
+        t0 = _time.monotonic()
         staged = stage_one(i)
         if staged is None:
             return
         check = staged.host_msm()
         verdicts[i] = check.mul_by_cofactor().is_identity()
+        if len(_host_times) < 64:
+            _host_times.append(_time.monotonic() - t0)
 
     def stage_chunk(vs_idx):
         staged, idxs = [], []
@@ -427,43 +550,138 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         ops = [s.device_operands(lambda n: pad) for s in staged]
         digits = np.stack([d for d, _ in ops])
         pts = np.stack([p for _, p in ops])
-        return idxs, msm.dispatch_window_sums_many(digits, pts)
+        return idxs, digits, pts
 
-    def device_done(pending) -> bool:
-        if pending is None:
-            return True
-        try:
-            return pending[1].is_ready()
-        except AttributeError:
-            return True
+    # Work-stealing pipeline.  The device lane is ONE worker thread that
+    # serializes every device-side call (launch + blocking fetch — both
+    # can stall for seconds when the tunnel hiccups, and the PJRT client
+    # must never be entered from two threads at once); the main thread
+    # stages chunks for it, verifies tail batches on the host with the
+    # native MSM in the meantime, and polls completed chunk results.
+    # Device readiness cannot be polled via jax (is_ready/block_until_ready
+    # return early on this runtime), but worker-thread completion can.
+    # Lane policy: the device is a PROBATIONARY helper.  Staging a batch
+    # for the device costs the host almost as much as verifying it
+    # outright (the native-MSM host path is very fast), so the device is
+    # only additive when its per-batch turnaround beats the host's.  One
+    # small probe chunk measures that; further chunks are submitted only
+    # while the device stays competitive.  A chunk that misses its hard
+    # deadline (3× the turnaround EMA, floored at 2 s) marks the device
+    # sick: its batches are re-verified on the host — identical exact math
+    # decides the verdict either way — and later calls skip the device
+    # for a cooldown period.
+    if _time.monotonic() < _device_cooldown_until[0]:
+        while remaining:
+            host_verify_one(remaining.pop())
+        return verdicts
+    dev = _DeviceLane.get()
 
-    def collect(pending):
+    ema_per_batch = 0.2  # seconds per batch; pessimistic prior
+    ema_is_prior = True
+    outstanding = []  # [(chunk_id, idxs, t_submit)]
+    device_sick = False
+
+    def submit(size=None):
+        size = chunk if size is None else size
+        ch = remaining[:size]
+        del remaining[:size]
+        pending = stage_chunk(ch)
         if pending is None:
             return
-        idxs, out_dev = pending
-        out = np.asarray(out_dev)
-        for j, i in enumerate(idxs):
-            check = msm.combine_window_sums(out[j])
-            verdicts[i] = check.mul_by_cofactor().is_identity()
+        idxs, digits, pts = pending
+        cid = dev.submit(digits, pts)
+        outstanding.append((cid, idxs, _time.monotonic()))
 
-    # Work-stealing pipeline: the device takes chunks from the front
-    # (keeping up to two launches queued so it never starves while the
-    # host stages), and the host lane eats batches from the tail whenever
-    # the device is busy — so a degraded device link degrades throughput
-    # to the host's native rate instead of stalling the pipeline.
-    def take_chunk():
-        ch = remaining[:chunk]
-        del remaining[:chunk]
-        return stage_chunk(ch)
+    def poll(block: bool):
+        """Apply finished chunk results; returns True if progress.  On a
+        deadline miss, fail the device over to the host."""
+        nonlocal device_sick, ema_per_batch, ema_is_prior
+        progress = False
+        while outstanding:
+            cid, idxs, t0 = outstanding[0]
+            deadline = t0 + max(3.0 * ema_per_batch * len(idxs), 2.0)
+            timeout = max(0.0, deadline - _time.monotonic()) if block \
+                else 0.0
+            out = dev.wait(cid, timeout)
+            if out is _PENDING:
+                if _time.monotonic() < deadline:
+                    return progress
+                device_sick = True  # missed deadline
+                _device_cooldown_until[0] = _time.monotonic() + 30.0
+                dev.abandon()
+                for _, idxs2, _t in outstanding:
+                    for i in idxs2:
+                        host_verify_one(i)
+                outstanding.clear()
+                return True
+            outstanding.pop(0)
+            per_batch = (_time.monotonic() - t0) / max(1, len(idxs))
+            ema_per_batch = per_batch if ema_is_prior else (
+                0.6 * ema_per_batch + 0.4 * per_batch)
+            ema_is_prior = False
+            if out is None:  # device error: decide on host
+                for i in idxs:
+                    host_verify_one(i)
+            else:
+                for j, i in enumerate(idxs):
+                    if decided[i]:
+                        continue  # host stole this batch back first
+                    decided[i] = 1
+                    check = msm.combine_window_sums(out[j])
+                    verdicts[i] = check.mul_by_cofactor().is_identity()
+            progress = True
+        return progress
 
-    in_flight = take_chunk() if remaining else None
-    while in_flight is not None:
-        nxt = take_chunk() if remaining else None  # queue the next launch
-        while (hybrid and remaining
-               and not device_done(in_flight)):
-            host_verify_one(remaining.pop())  # steal from the tail
-        collect(in_flight)
-        in_flight = nxt
+    def device_competitive() -> bool:
+        if not _host_times:
+            return True  # no host measurement yet: keep probing
+        t_host = sorted(_host_times)[len(_host_times) // 2]
+        return ema_per_batch < 1.3 * t_host
+
+    probed = False
+    while remaining or outstanding:
+        if device_sick:
+            while remaining:
+                host_verify_one(remaining.pop())
+            break
+        # device lane: one probe chunk first; keep up to two chunks
+        # queued only while the device beats the host per batch
+        if remaining and not outstanding and not probed:
+            submit(size=min(2, chunk))  # cheap probe: 2 batches
+            probed = True
+        while (remaining and outstanding and len(outstanding) < 2
+               and not ema_is_prior and device_competitive()):
+            submit()
+        poll(block=False)
+        # host lane: steal one batch from the tail, then re-poll
+        if hybrid and remaining and outstanding:
+            host_verify_one(remaining.pop())
+        elif outstanding:
+            if hybrid:
+                # Nothing left in the pool: RACE the in-flight chunks —
+                # re-verify their batches on the host (last chunk first,
+                # its results are furthest away), dropping any chunk the
+                # host fully overtakes.  Whoever decides first wins;
+                # the math is identical either way.
+                stole = False
+                for ci in range(len(outstanding) - 1, -1, -1):
+                    cid, idxs, _t0 = outstanding[ci]
+                    undecided = [i for i in idxs if not decided[i]]
+                    if undecided:
+                        host_verify_one(undecided[-1])
+                        stole = True
+                        if len(undecided) == 1:  # chunk fully overtaken
+                            dev.discard(cid)
+                            outstanding.pop(ci)
+                        break
+                if not stole:
+                    poll(block=True)
+                else:
+                    poll(block=False)
+            else:
+                poll(block=True)
+        elif remaining:
+            host_verify_one(remaining.pop())
     return verdicts
 
 

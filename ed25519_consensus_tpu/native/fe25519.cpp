@@ -558,6 +558,165 @@ IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
 
 }  // namespace ifma
 
+// ---- 8-way Edwards ops + transposed Straus accumulation ------------------
+//
+// The host-MSM hot loop is the window-digit accumulation: 64 windows ×
+// n sequential complete additions (reference src/batch.rs:207-210 via
+// dalek Straus).  The 64 per-window partial sums are INDEPENDENT, so 8
+// windows ride the 8 IFMA lanes: for each term, one vpgatherqq pulls the
+// 8 windows' digit entries out of the term's multiples table (consecutive
+// u64 limbs, element offsets digit·20 + coord·5 + limb), and one 8-lane
+// complete addition advances all 8 window sums at once.  Zero digits
+// naturally add the identity (table entry 0).  The final 64-window Horner
+// combine is scalar (64·4 doublings — microseconds).
+
+namespace ifma {
+
+struct ge8 {
+    fe8 X, Y, Z, T;
+};
+
+IFMA_TARGET static void ge8_add(ge8 &r, const ge8 &p, const ge8 &q,
+                                const fe8 &d2) {
+    fe8 a, b, c, d, e, f, g, h, t0, t1;
+    fe8_sub(t0, p.Y, p.X);
+    fe8_sub(t1, q.Y, q.X);
+    fe8_mul(a, t0, t1);
+    fe8_add(t0, p.Y, p.X);
+    fe8_add(t1, q.Y, q.X);
+    fe8_mul(b, t0, t1);
+    fe8_mul(c, p.T, d2);
+    fe8_mul(c, c, q.T);
+    fe8_mul(d, p.Z, q.Z);
+    fe8_add(d, d, d);
+    fe8_sub(e, b, a);
+    fe8_sub(f, d, c);
+    fe8_add(g, d, c);
+    fe8_add(h, b, a);
+    fe8_mul(r.X, e, f);
+    fe8_mul(r.Y, g, h);
+    fe8_mul(r.Z, f, g);
+    fe8_mul(r.T, e, h);
+}
+
+// Build the 16-entry multiples tables of 8 points at once (the entries of
+// different points are independent, so the 14 chained additions ride the
+// 8 lanes).  `points` is 8 raw 128-byte X‖Y‖Z‖T rows; `tables` receives 8
+// consecutive per-point tables in the scalar layout (320 u64 each).
+IFMA_TARGET static void table_build8(const uint8_t *points, u64 *tables) {
+    fe8 d2;
+    fe8_splat(d2, FE_2D);
+    ge8 p;
+    fe8 *pc[4] = {&p.X, &p.Y, &p.Z, &p.T};
+    for (int c = 0; c < 4; c++) {
+        fe lane[8];
+        for (int l = 0; l < 8; l++)
+            fe_frombytes(lane[l], points + 128 * l + 32 * c);
+        for (int i = 0; i < 5; i++)
+            pc[c]->v[i] = _mm512_set_epi64(
+                lane[7].v[i], lane[6].v[i], lane[5].v[i], lane[4].v[i],
+                lane[3].v[i], lane[2].v[i], lane[1].v[i], lane[0].v[i]);
+    }
+
+    auto store_entry = [&](int k, const ge8 &e) {
+        alignas(64) u64 lanes[5][8];
+        const fe8 *coords[4] = {&e.X, &e.Y, &e.Z, &e.T};
+        for (int c = 0; c < 4; c++) {
+            for (int i = 0; i < 5; i++)
+                _mm512_store_si512((__m512i *)lanes[i], coords[c]->v[i]);
+            for (int l = 0; l < 8; l++)
+                for (int i = 0; i < 5; i++)
+                    tables[320 * l + 20 * k + 5 * c + i] = lanes[i][l];
+        }
+    };
+
+    for (int l = 0; l < 8; l++) {
+        ge id;
+        ge_identity(id);
+        memcpy(tables + 320 * l, &id, 160);
+    }
+    ge8 e = p;
+    store_entry(1, e);
+    for (int k = 2; k < 16; k++) {
+        ge8_add(e, e, p, d2);
+        store_entry(k, e);
+    }
+}
+
+// Accumulate the 64 per-window Straus sums over all n terms.
+// `tables` is the scalar layout: per term, 16 entries × (X,Y,Z,T) × 5
+// u64 limbs contiguous (u64 element offset = digit·20 + coord·5 + limb).
+// `sums` receives the 64 window sums (window w = 8·group + lane) in the
+// same 20-u64 point layout.
+IFMA_TARGET static void straus_accumulate8(const u64 *tables,
+                                           const uint8_t *scalars,
+                                           uint64_t n, u64 *sums) {
+    fe8 d2;
+    fe8_splat(d2, FE_2D);
+    ge8 acc[8];
+    const __m512i zero = _mm512_setzero_si512();
+    const __m512i one = _mm512_set1_epi64(1);
+    for (int g = 0; g < 8; g++) {
+        for (int i = 0; i < 5; i++) {
+            acc[g].X.v[i] = zero;
+            acc[g].Y.v[i] = i == 0 ? one : zero;
+            acc[g].Z.v[i] = i == 0 ? one : zero;
+            acc[g].T.v[i] = zero;
+        }
+    }
+    const __m512i twenty = _mm512_set1_epi64(20);
+    for (uint64_t t = 0; t < n; t++) {
+        const u64 *base = tables + 320 * t;
+        const uint8_t *s = scalars + 32 * t;
+        int dig[64];
+        for (int w = 0; w < 64; w++)
+            dig[w] = (s[w >> 1] >> ((w & 1) * 4)) & 15;
+        // Skip all-zero window groups: the 128-bit blinder terms that
+        // dominate a staged batch populate only groups 0..3.
+        int ngroups = 8;
+        while (ngroups > 0) {
+            const int *d = dig + 8 * (ngroups - 1);
+            int any = 0;
+            for (int l = 0; l < 8; l++) any |= d[l];
+            if (any) break;
+            ngroups--;
+        }
+        for (int g = 0; g < ngroups; g++) {
+            const int *d = dig + 8 * g;
+            __m512i idx = _mm512_mullo_epi64(
+                _mm512_set_epi64(d[7], d[6], d[5], d[4], d[3], d[2], d[1],
+                                 d[0]),
+                twenty);
+            ge8 entry;
+            fe8 *coords[4] = {&entry.X, &entry.Y, &entry.Z, &entry.T};
+            for (int c = 0; c < 4; c++) {
+                for (int l = 0; l < 5; l++) {
+                    __m512i off = _mm512_add_epi64(
+                        idx, _mm512_set1_epi64(c * 5 + l));
+                    coords[c]->v[l] = _mm512_i64gather_epi64(
+                        off, (const long long *)base, 8);
+                }
+            }
+            ge8_add(acc[g], acc[g], entry, d2);
+        }
+    }
+    alignas(64) u64 lanes[5][8];
+    for (int g = 0; g < 8; g++) {
+        const fe8 *coords[4] = {&acc[g].X, &acc[g].Y, &acc[g].Z,
+                                &acc[g].T};
+        for (int c = 0; c < 4; c++) {
+            for (int i = 0; i < 5; i++)
+                _mm512_store_si512((__m512i *)lanes[i],
+                                   coords[c]->v[i]);
+            for (int l = 0; l < 8; l++)
+                for (int i = 0; i < 5; i++)
+                    sums[(8 * g + l) * 20 + c * 5 + i] = lanes[i][l];
+        }
+    }
+}
+
+}  // namespace ifma
+
 static bool ifma_available() {
     static int avail = -1;
     if (avail < 0)
@@ -590,7 +749,15 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
     if (n > 0) {
         // per-point tables: T[i][j] = [j] P_i, j = 0..15
         ge *tables = new ge[n * 16];
-        for (uint64_t i = 0; i < n; i++) {
+        uint64_t i0 = 0;
+#if defined(__x86_64__)
+        if (ifma_available()) {
+            for (; i0 + 8 <= n; i0 += 8)
+                ifma::table_build8(points + 128 * i0,
+                                   (u64 *)(tables + 16 * i0));
+        }
+#endif
+        for (uint64_t i = i0; i < n; i++) {
             ge p;
             ge_frombytes128(p, points + 128 * i);
             ge_identity(tables[16 * i]);
@@ -598,6 +765,26 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
             for (int j = 2; j < 16; j++)
                 ge_add(tables[16 * i + j], tables[16 * i + j - 1], p);
         }
+#if defined(__x86_64__)
+        if (ifma_available() && n >= 16) {
+            // 8-way transposed accumulation: 64 independent window sums,
+            // then a scalar Horner combine (MSB-first).
+            u64 *sums = new u64[64 * 20];
+            ifma::straus_accumulate8((const u64 *)tables, scalars, n,
+                                     sums);
+            for (int w = 63; w >= 0; w--) {
+                if (w != 63)
+                    for (int k = 0; k < 4; k++) ge_double(acc, acc);
+                ge s;
+                memcpy(&s, sums + 20 * w, 160);
+                ge_add(acc, acc, s);
+            }
+            delete[] sums;
+            delete[] tables;
+            ge_tobytes128(out, acc);
+            return;
+        }
+#endif
         for (int w = 63; w >= 0; w--) {
             if (w != 63)
                 for (int k = 0; k < 4; k++) ge_double(acc, acc);
