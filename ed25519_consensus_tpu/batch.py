@@ -130,22 +130,27 @@ class StagedBatch:
       the device path splits them against `coeff_shifts`).
     * coeff_shifts: matching [2^128]·point host Points (basepoint constant
       + per-key cache).
-    * z_ints: the n per-signature 128-bit blinders.
+    * z_blob: the n per-signature 128-bit blinders as 16-byte
+      little-endian rows (bytes, n×16).
     * raw_points: ((1+m+n), 128) uint8 — canonical X‖Y‖Z‖T rows for
       [B, A_0..A_{m-1}, R_0..R_{n-1}]; columns/terms order is
       [coeff terms..., split-high terms..., R terms...]."""
 
-    __slots__ = ("coeffs", "coeff_shifts", "z_ints", "raw_points")
+    __slots__ = ("coeffs", "coeff_shifts", "z_blob", "raw_points")
 
-    def __init__(self, coeffs, coeff_shifts, z_ints, raw_points):
+    def __init__(self, coeffs, coeff_shifts, z_blob, raw_points):
         self.coeffs = coeffs
         self.coeff_shifts = coeff_shifts
-        self.z_ints = z_ints
+        self.z_blob = z_blob
         self.raw_points = raw_points
 
     @property
+    def n_sigs(self) -> int:
+        return len(self.z_blob) // 16
+
+    @property
     def n_terms(self) -> int:
-        return len(self.coeffs) + len(self.z_ints)
+        return len(self.coeffs) + self.n_sigs
 
     @property
     def n_device_terms(self) -> int:
@@ -159,9 +164,15 @@ class StagedBatch:
         when available)."""
         from . import native
 
-        return native.vartime_msm_buffer(
-            self.coeffs + self.z_ints, self.raw_points
+        n = self.n_sigs
+        zs = np.zeros((n, 32), dtype=np.uint8)
+        zs[:, :16] = np.frombuffer(self.z_blob, dtype=np.uint8).reshape(
+            n, 16
         )
+        sblob = b"".join(
+            int(c).to_bytes(32, "little") for c in self.coeffs
+        ) + zs.tobytes()
+        return native.vartime_msm_scblob(sblob, self.raw_points)
 
     def device_operands(self, pad_fn):
         """Build the padded (digits (32, N) int32, points (4, NLIMBS, N)
@@ -180,17 +191,16 @@ class StagedBatch:
                 hi_p.append(sp)
         n_coeff = len(lo)
         n_head = n_coeff + len(hi_s)
-        n = n_head + len(self.z_ints)
+        n = n_head + self.n_sigs
         N = pad_fn(n)
         digits = np.zeros((limbs.NWINDOWS, N), dtype=np.int8)
         digits[:, :n_coeff] = limbs.pack_scalar_windows(lo)
         if hi_s:
             digits[:, n_coeff:n_head] = limbs.pack_scalar_windows(hi_s)
-        if self.z_ints:
-            zb = np.frombuffer(
-                b"".join(z.to_bytes(16, "little") for z in self.z_ints),
-                dtype=np.uint8,
-            ).reshape(len(self.z_ints), 16)
+        if self.n_sigs:
+            zb = np.frombuffer(self.z_blob, dtype=np.uint8).reshape(
+                self.n_sigs, 16
+            )
             digits[:, n_head:n] = limbs.pack_u128_windows(zb)
         pts = limbs.identity_point_batch(N)
         pts[..., :n_coeff] = limbs.pack_points_from_raw(
@@ -254,30 +264,61 @@ class Verifier:
         if not ok.all():
             raise InvalidSignature()
 
-        B_acc = 0
-        A_coeffs, A_shifts = [], []
-        z_ints = []
-        for (vk_bytes, sigs), A_row in zip(groups, raw[:m]):
-            a_acc = 0
-            for k, sig in sigs:
-                s = int.from_bytes(sig.s_bytes, "little")
-                if s >= L:  # ZIP215 rule 2: s MUST be canonical
-                    raise InvalidSignature()
-                z = gen_u128(rng)
-                B_acc += z * s
-                a_acc += z * k
-                z_ints.append(z)
-            A_coeffs.append(a_acc % L)
-            A_shifts.append(
-                _shift128_for_key(vk_bytes.to_bytes(), A_row)
-            )
+        # Per-signature blobs (queue order) + one bulk draw of blinders.
+        s_blob = b"".join(
+            sig.s_bytes for _, sigs in groups for _, sig in sigs
+        )
+        k_blob = b"".join(
+            k.to_bytes(32, "little")
+            for _, sigs in groups for k, _ in sigs
+        )
+        if rng is None:
+            z_blob = secrets.token_bytes(16 * n)
+        else:
+            z_blob = rng.getrandbits(128 * n).to_bytes(16 * n, "little") \
+                if n else b""
+        group_sizes = [len(sigs) for _, sigs in groups]
+
+        res = native.stage_scalars(s_blob, k_blob, z_blob, n, group_sizes)
+        if res is None:
+            raise InvalidSignature()  # some s ≥ ℓ (ZIP215 rule 2)
+        if res is NotImplemented:
+            # Exact-Python fallback for the native scalar staging.
+            B_acc = 0
+            A_accs = []
+            idx = 0
+            for size in group_sizes:
+                a_acc = 0
+                for j in range(size):
+                    s = int.from_bytes(
+                        s_blob[32 * idx: 32 * idx + 32], "little"
+                    )
+                    if s >= L:
+                        raise InvalidSignature()
+                    k = int.from_bytes(
+                        k_blob[32 * idx: 32 * idx + 32], "little"
+                    )
+                    z = int.from_bytes(
+                        z_blob[16 * idx: 16 * idx + 16], "little"
+                    )
+                    B_acc += z * s
+                    a_acc += z * k
+                    idx += 1
+                A_accs.append(a_acc)
+        else:
+            B_acc, A_accs = res
+
+        A_shifts = [
+            _shift128_for_key(vk_bytes.to_bytes(), A_row)
+            for (vk_bytes, _), A_row in zip(groups, raw[:m])
+        ]
         raw_points = np.concatenate(
             [_basepoint_raw_row(), raw], axis=0
         )  # rows: [B, A_0..A_{m-1}, R_0..R_{n-1}]
         return StagedBatch(
-            coeffs=[(-B_acc) % L] + A_coeffs,
+            coeffs=[(-B_acc) % L] + [a % L for a in A_accs],
             coeff_shifts=[edwards.basepoint_shift128()] + A_shifts,
-            z_ints=z_ints,
+            z_blob=z_blob,
             raw_points=raw_points,
         )
 

@@ -80,6 +80,12 @@ def load():
         lib.edwards_vartime_msm.restype = None
         lib.zip215_check_prehashed.argtypes = [ctypes.c_char_p] * 5
         lib.zip215_check_prehashed.restype = ctypes.c_int
+        lib.stage_scalars.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.stage_scalars.restype = ctypes.c_int
         _self_check(lib)
         _lib = lib
     except Exception:
@@ -227,6 +233,33 @@ def decompress_batch_buffer(blob: bytes, n: int):
     return raw, ok
 
 
+def stage_scalars(s_blob: bytes, k_blob: bytes, z_blob: bytes, n: int,
+                  group_sizes) -> "tuple | None":
+    """Native per-signature scalar staging (ZIP215 `s < ℓ` checks + the
+    unreduced coalescing sums Σz·s and per-group Σz·k).  Returns
+    (B_acc, [A_acc_g...]) as ints, or None if any s is non-canonical.
+    Returns NotImplemented when the native library is unavailable (caller
+    falls back to the exact-Python loop)."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    m = len(group_sizes)
+    gs = (ctypes.c_uint64 * m)(*group_sizes)
+    b_out = ctypes.create_string_buffer(56)
+    a_out = ctypes.create_string_buffer(56 * m)
+    ok = lib.stage_scalars(s_blob, k_blob, z_blob, n,
+                           ctypes.cast(gs, ctypes.c_char_p), m, b_out,
+                           a_out)
+    if not ok:
+        return None
+    b_acc = int.from_bytes(b_out.raw, "little")
+    a_accs = [
+        int.from_bytes(a_out.raw[56 * g: 56 * (g + 1)], "little")
+        for g in range(m)
+    ]
+    return b_acc, a_accs
+
+
 def point_from_raw(row) -> "object":
     """One (128,) uint8 raw row → exact host Point."""
     from ..ops.edwards import Point
@@ -280,15 +313,26 @@ def vartime_msm_buffer(scalars, raw_points):
     """Σ[c_i]P_i with points given as a (T, 128) uint8 raw buffer (the
     decompress_batch_buffer format) — the host-backend MSM without any
     per-point Python objects.  Exact-Python fallback."""
+    sblob = b"".join(int(s).to_bytes(32, "little") for s in scalars)
+    return vartime_msm_scblob(sblob, raw_points)
+
+
+def vartime_msm_scblob(sblob: bytes, raw_points):
+    """Σ[c_i]P_i with scalars already in blob form (n × 32-byte
+    little-endian) and points as the raw (n, 128) uint8 buffer.
+    Exact-Python fallback."""
+    n = len(sblob) // 32
     lib = load()
     if lib is None:
         from ..ops import edwards
 
+        scalars = [
+            int.from_bytes(sblob[32 * i: 32 * (i + 1)], "little")
+            for i in range(n)
+        ]
         return edwards.multiscalar_mul(
             scalars, [point_from_raw(r) for r in raw_points]
         )
-    n = len(scalars)
-    sblob = b"".join(int(s).to_bytes(32, "little") for s in scalars)
     out = ctypes.create_string_buffer(128)
     lib.edwards_vartime_msm(sblob, raw_points.tobytes(), n, out)
     return point_from_raw(out.raw)

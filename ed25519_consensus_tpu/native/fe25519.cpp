@@ -831,6 +831,71 @@ int zip215_check_prehashed(const uint8_t *minusA128, const uint8_t *R128,
     return (fe_iszero(diff.X) && fe_eq(diff.Y, diff.Z)) ? 1 : 0;
 }
 
+// Batch scalar staging: the per-signature host loop of the batch verifier
+// (reference src/batch.rs:182-203).  For each signature: enforce the
+// ZIP215 `s < ℓ` canonicality rule, and accumulate the coalescing sums
+//   B_acc  += z·s           (over the whole batch)
+//   A_acc_g += z·k          (per verification-key group)
+// UNREDUCED in 448-bit accumulators (products are < 2^384; the single
+// final `mod ℓ` per coefficient happens in Python, where big ints are
+// free).  Inputs are flat little-endian blobs in queue order; grouping
+// follows group_sizes.  Returns 1, or 0 if any s ≥ ℓ (all-or-nothing).
+static const u64 SC_L[4] = {0x5812631A5CF5D3EDULL, 0x14DEF9DEA2F79CD6ULL,
+                            0x0000000000000000ULL, 0x1000000000000000ULL};
+
+static inline bool sc_is_canonical(const u64 s[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (s[i] < SC_L[i]) return true;
+        if (s[i] > SC_L[i]) return false;
+    }
+    return false;  // s == L
+}
+
+// acc[0..6] += z[0..1] * x[0..3]   (2x4 -> 6 limb product, 7-limb acc)
+static inline void sc_muladd(u64 acc[7], const u64 z[2], const u64 x[4]) {
+    u64 prod[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 2; i++) {
+        u64 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)z[i] * x[j] + prod[i + j] + carry;
+            prod[i + j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        prod[i + 4] += carry;
+    }
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)acc[i] + prod[i];
+        acc[i] = (u64)c;
+        c >>= 64;
+    }
+    acc[6] += (u64)c;
+}
+
+int stage_scalars(const uint8_t *s_bytes, const uint8_t *k_bytes,
+                  const uint8_t *z_bytes, uint64_t n,
+                  const u64 *group_sizes, uint64_t m,
+                  uint8_t *b_acc_out /*56B*/,
+                  uint8_t *a_accs_out /*m*56B*/) {
+    u64 B[7] = {0, 0, 0, 0, 0, 0, 0};
+    uint64_t idx = 0;
+    for (uint64_t g = 0; g < m; g++) {
+        u64 A[7] = {0, 0, 0, 0, 0, 0, 0};
+        for (u64 j = 0; j < group_sizes[g]; j++, idx++) {
+            u64 s[4], k[4], z[2];
+            memcpy(s, s_bytes + 32 * idx, 32);
+            memcpy(k, k_bytes + 32 * idx, 32);
+            memcpy(z, z_bytes + 16 * idx, 16);
+            if (!sc_is_canonical(s)) return 0;
+            sc_muladd(B, z, s);
+            sc_muladd(A, z, k);
+        }
+        memcpy(a_accs_out + 56 * g, A, 56);
+    }
+    memcpy(b_acc_out, B, 56);
+    return 1;
+}
+
 // Batched ZIP215 decompression.
 //   encodings: n * 32 bytes
 //   out:       n * 128 bytes — X ‖ Y ‖ Z ‖ T, each a canonical 32-byte
