@@ -455,10 +455,15 @@ IFMA_TARGET static inline void fe8_cneg(fe8 &h, __mmask8 m) {
         h.v[i] = _mm512_mask_blend_epi64(m, h.v[i], n.v[i]);
 }
 
-// Batched ZIP215 decompression of 8 encodings; bit-identical to the
-// scalar loop in zip215_decompress_batch.
-IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
-                                    uint8_t *ok) {
+// Batched ZIP215 decompression, split into prepare / inverse-sqrt chain /
+// finish so TWO 8-lane groups can interleave their (latency-bound,
+// 252-squaring) chains and overlap in the out-of-order core.
+struct dec8_state {
+    fe8 y, u, v, v3, t0;
+    __mmask8 sign_m;
+};
+
+IFMA_TARGET static void dec8_prepare(const uint8_t *enc, dec8_state &st) {
     // transpose: load each lane's y via the scalar frombytes
     fe ys[8];
     int signs[8];
@@ -466,33 +471,42 @@ IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
         fe_frombytes(ys[l], enc + 32 * l);
         signs[l] = enc[32 * l + 31] >> 7;
     }
-    fe8 y;
     for (int i = 0; i < 5; i++)
-        y.v[i] = _mm512_set_epi64(ys[7].v[i], ys[6].v[i], ys[5].v[i],
-                                  ys[4].v[i], ys[3].v[i], ys[2].v[i],
-                                  ys[1].v[i], ys[0].v[i]);
-    __mmask8 sign_m = 0;
-    for (int l = 0; l < 8; l++) sign_m |= (signs[l] & 1) << l;
+        st.y.v[i] = _mm512_set_epi64(ys[7].v[i], ys[6].v[i], ys[5].v[i],
+                                     ys[4].v[i], ys[3].v[i], ys[2].v[i],
+                                     ys[1].v[i], ys[0].v[i]);
+    st.sign_m = 0;
+    for (int l = 0; l < 8; l++) st.sign_m |= (signs[l] & 1) << l;
 
-    fe8 one, d8, sqrtm1_8;
+    fe8 one, d8;
     fe one_s;
     fe_one(one_s);
     fe8_splat(one, one_s);
     fe8_splat(d8, FE_D);
+
+    fe8 yy, v7;
+    fe8_sq(yy, st.y);
+    fe8_sub(st.u, yy, one);         // u = y^2 - 1
+    fe8_mul(st.v, yy, d8);
+    fe8_add(st.v, st.v, one);       // v = d y^2 + 1
+    fe8_sq(st.v3, st.v);
+    fe8_mul(st.v3, st.v3, st.v);    // v^3
+    fe8_sq(v7, st.v3);
+    fe8_mul(v7, v7, st.v);          // v^7
+    fe8_mul(st.t0, st.u, v7);       // u v^7 — the chain input
+}
+
+IFMA_TARGET static void dec8_finish(const dec8_state &st, const fe8 &t1,
+                                    uint8_t *out, uint8_t *ok) {
+    const fe8 &y = st.y;
+    const fe8 &u = st.u;
+    const fe8 &v = st.v;
+    __mmask8 sign_m = st.sign_m;
+    fe8 sqrtm1_8;
     fe8_splat(sqrtm1_8, FE_SQRTM1);
 
-    fe8 yy, u, v, v3, v7, t0, t1, r, chk;
-    fe8_sq(yy, y);
-    fe8_sub(u, yy, one);            // u = y^2 - 1
-    fe8_mul(v, yy, d8);
-    fe8_add(v, v, one);             // v = d y^2 + 1
-    fe8_sq(v3, v);
-    fe8_mul(v3, v3, v);             // v^3
-    fe8_sq(v7, v3);
-    fe8_mul(v7, v7, v);             // v^7
-    fe8_mul(t0, u, v7);
-    fe8_pow22523(t1, t0);           // (u v^7)^((p-5)/8)
-    fe8_mul(r, u, v3);
+    fe8 r, chk;
+    fe8_mul(r, u, st.v3);
     fe8_mul(r, r, t1);              // candidate root
 
     fe8_sq(chk, r);
@@ -554,6 +568,67 @@ IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
         fe_tobytes(o + 96, tt);
         ok[l] = 1;
     }
+}
+
+IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
+                                    uint8_t *ok) {
+    dec8_state st;
+    dec8_prepare(enc, st);
+    fe8 t1;
+    fe8_pow22523(t1, st.t0);
+    dec8_finish(st, t1, out, ok);
+}
+
+// Two interleaved inverse-sqrt chains: the 252 squarings are a pure
+// dependency chain, so pairing two independent 8-lane chains roughly
+// doubles utilization of the IFMA pipes.
+IFMA_TARGET static void fe8_pow22523_x2(fe8 &o1, fe8 &o2, const fe8 &z1,
+                                        const fe8 &z2) {
+#define SQ2(a1, a2, b1, b2) fe8_sq(a1, b1); fe8_sq(a2, b2)
+#define MUL2(a1, a2, b1, b2, c1, c2) fe8_mul(a1, b1, c1); fe8_mul(a2, b2, c2)
+    fe8 t0a, t1a, t2a, t0b, t1b, t2b;
+    SQ2(t0a, t0b, z1, z2);
+    SQ2(t1a, t1b, t0a, t0b);
+    SQ2(t1a, t1b, t1a, t1b);
+    MUL2(t1a, t1b, t1a, t1b, z1, z2);
+    MUL2(t0a, t0b, t0a, t0b, t1a, t1b);
+    SQ2(t0a, t0b, t0a, t0b);
+    MUL2(t0a, t0b, t1a, t1b, t0a, t0b);
+    SQ2(t1a, t1b, t0a, t0b);
+    for (int i = 1; i < 5; i++) { SQ2(t1a, t1b, t1a, t1b); }
+    MUL2(t0a, t0b, t1a, t1b, t0a, t0b);
+    SQ2(t1a, t1b, t0a, t0b);
+    for (int i = 1; i < 10; i++) { SQ2(t1a, t1b, t1a, t1b); }
+    MUL2(t1a, t1b, t1a, t1b, t0a, t0b);
+    SQ2(t2a, t2b, t1a, t1b);
+    for (int i = 1; i < 20; i++) { SQ2(t2a, t2b, t2a, t2b); }
+    MUL2(t1a, t1b, t2a, t2b, t1a, t1b);
+    for (int i = 0; i < 10; i++) { SQ2(t1a, t1b, t1a, t1b); }
+    MUL2(t0a, t0b, t1a, t1b, t0a, t0b);
+    SQ2(t1a, t1b, t0a, t0b);
+    for (int i = 1; i < 50; i++) { SQ2(t1a, t1b, t1a, t1b); }
+    MUL2(t1a, t1b, t1a, t1b, t0a, t0b);
+    SQ2(t2a, t2b, t1a, t1b);
+    for (int i = 1; i < 100; i++) { SQ2(t2a, t2b, t2a, t2b); }
+    MUL2(t1a, t1b, t2a, t2b, t1a, t1b);
+    for (int i = 0; i < 50; i++) { SQ2(t1a, t1b, t1a, t1b); }
+    MUL2(t0a, t0b, t1a, t1b, t0a, t0b);
+    SQ2(t0a, t0b, t0a, t0b);
+    SQ2(t0a, t0b, t0a, t0b);
+    MUL2(o1, o2, t0a, t0b, z1, z2);
+#undef SQ2
+#undef MUL2
+}
+
+IFMA_TARGET static void decompress16(const uint8_t *enc, uint8_t *out,
+                                     uint8_t *ok) {
+    dec8_state sa, sb;
+    dec8_prepare(enc, sa);
+    dec8_prepare(enc + 32 * 8, sb);
+    fe8 t1a, t1b;
+    fe8_pow22523_x2(t1a, t1b, sa.t0, sb.t0);
+    dec8_finish(sa, t1a, out, ok);
+    dec8_finish(sb, t1b, out + 128 * 8, ok + 8);
 }
 
 }  // namespace ifma
@@ -643,6 +718,59 @@ IFMA_TARGET static void table_build8(const uint8_t *points, u64 *tables) {
     }
 }
 
+// Two interleaved table builds (16 points): each build's 14 chained
+// additions are a pure dependency chain, so pairing two keeps the IFMA
+// pipes busy (same trick as fe8_pow22523_x2).
+IFMA_TARGET static void table_build8_x2(const uint8_t *points,
+                                        u64 *tables) {
+    fe8 d2;
+    fe8_splat(d2, FE_2D);
+    ge8 pa, pb;
+    for (int half = 0; half < 2; half++) {
+        ge8 &p = half ? pb : pa;
+        const uint8_t *pts = points + 128 * 8 * half;
+        fe8 *pc[4] = {&p.X, &p.Y, &p.Z, &p.T};
+        for (int c = 0; c < 4; c++) {
+            fe lane[8];
+            for (int l = 0; l < 8; l++)
+                fe_frombytes(lane[l], pts + 128 * l + 32 * c);
+            for (int i = 0; i < 5; i++)
+                pc[c]->v[i] = _mm512_set_epi64(
+                    lane[7].v[i], lane[6].v[i], lane[5].v[i],
+                    lane[4].v[i], lane[3].v[i], lane[2].v[i],
+                    lane[1].v[i], lane[0].v[i]);
+        }
+    }
+
+    auto store_entry = [&](int half, int k, const ge8 &e) {
+        u64 *tbl = tables + 320 * 8 * half;
+        alignas(64) u64 lanes[5][8];
+        const fe8 *coords[4] = {&e.X, &e.Y, &e.Z, &e.T};
+        for (int c = 0; c < 4; c++) {
+            for (int i = 0; i < 5; i++)
+                _mm512_store_si512((__m512i *)lanes[i], coords[c]->v[i]);
+            for (int l = 0; l < 8; l++)
+                for (int i = 0; i < 5; i++)
+                    tbl[320 * l + 20 * k + 5 * c + i] = lanes[i][l];
+        }
+    };
+
+    for (int l = 0; l < 16; l++) {
+        ge id;
+        ge_identity(id);
+        memcpy(tables + 320 * l, &id, 160);
+    }
+    ge8 ea = pa, eb = pb;
+    store_entry(0, 1, ea);
+    store_entry(1, 1, eb);
+    for (int k = 2; k < 16; k++) {
+        ge8_add(ea, ea, pa, d2);
+        ge8_add(eb, eb, pb, d2);
+        store_entry(0, k, ea);
+        store_entry(1, k, eb);
+    }
+}
+
 // Accumulate the 64 per-window Straus sums over all n terms.
 // `tables` is the scalar layout: per term, 16 entries × (X,Y,Z,T) × 5
 // u64 limbs contiguous (u64 element offset = digit·20 + coord·5 + limb).
@@ -664,8 +792,20 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
             acc[g].T.v[i] = zero;
         }
     }
+    // Two accumulator sets (even/odd terms) halve the add-dependency
+    // chains per window group; they are folded together at the end.
+    ge8 acc2[8];
+    for (int g = 0; g < 8; g++) {
+        for (int i = 0; i < 5; i++) {
+            acc2[g].X.v[i] = zero;
+            acc2[g].Y.v[i] = i == 0 ? one : zero;
+            acc2[g].Z.v[i] = i == 0 ? one : zero;
+            acc2[g].T.v[i] = zero;
+        }
+    }
     const __m512i twenty = _mm512_set1_epi64(20);
     for (uint64_t t = 0; t < n; t++) {
+        ge8 *accs = (t & 1) ? acc2 : acc;
         const u64 *base = tables + 320 * t;
         const uint8_t *s = scalars + 32 * t;
         int dig[64];
@@ -697,9 +837,11 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
                         off, (const long long *)base, 8);
                 }
             }
-            ge8_add(acc[g], acc[g], entry, d2);
+            ge8_add(accs[g], accs[g], entry, d2);
         }
     }
+    for (int g = 0; g < 8; g++)
+        ge8_add(acc[g], acc[g], acc2[g], d2);
     alignas(64) u64 lanes[5][8];
     for (int g = 0; g < 8; g++) {
         const fe8 *coords[4] = {&acc[g].X, &acc[g].Y, &acc[g].Z,
@@ -752,6 +894,9 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
         uint64_t i0 = 0;
 #if defined(__x86_64__)
         if (ifma_available()) {
+            for (; i0 + 16 <= n; i0 += 16)
+                ifma::table_build8_x2(points + 128 * i0,
+                                      (u64 *)(tables + 16 * i0));
             for (; i0 + 8 <= n; i0 += 8)
                 ifma::table_build8(points + 128 * i0,
                                    (u64 *)(tables + 16 * i0));
@@ -906,7 +1051,11 @@ void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
     uint64_t i0 = 0;
 #if defined(__x86_64__)
     if (ifma_available()) {
-        // 8-way AVX512-IFMA main loop; scalar tail below.
+        // 16-way (two interleaved 8-lane chains), then 8-way, then the
+        // scalar tail below.
+        for (; i0 + 16 <= n; i0 += 16)
+            ifma::decompress16(encodings + 32 * i0, out + 128 * i0,
+                               ok + i0);
         for (; i0 + 8 <= n; i0 += 8)
             ifma::decompress8(encodings + 32 * i0, out + 128 * i0,
                               ok + i0);
