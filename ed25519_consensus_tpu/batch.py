@@ -425,6 +425,11 @@ class Verifier:
 _device_cooldown_until = [0.0]
 _device_lane_stuck = [False]
 
+# Observability (SURVEY.md §5): counters for the most recent verify_many
+# call — batch/signature totals, the device/host lane split, and wall
+# time.  Read-only snapshot; refreshed on every call.
+last_run_stats = {}
+
 _PENDING = object()
 
 # All device-side calls from every lane go through this lock: the PJRT
@@ -503,11 +508,21 @@ class _DeviceLane:
         _device_lane_stuck[0] = True
         type(self)._instance = None
 
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop the worker before interpreter teardown: a thread parked
+        inside the accelerator runtime at finalization aborts the
+        process."""
+        self._q.put(None)
+        self._thread.join(timeout)
+
     def _run(self):
         from .ops import msm as _msm
 
         while True:
-            cid, digits, pts = self._q.get()
+            item = self._q.get()
+            if item is None:
+                return
+            cid, digits, pts = item
             try:
                 with _DEVICE_CALL_LOCK:
                     out = np.asarray(
@@ -521,6 +536,17 @@ class _DeviceLane:
                 else:
                     self._results[cid] = out
                 self._cv.notify_all()
+
+
+def _shutdown_device_lane():
+    inst = _DeviceLane._instance
+    if inst is not None and inst.healthy():
+        inst.shutdown()
+
+
+import atexit  # noqa: E402  (registration belongs next to the lane)
+
+atexit.register(_shutdown_device_lane)
 
 
 def device_lane_stuck() -> bool:
@@ -555,6 +581,21 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     verifiers = list(verifiers)
     verdicts = [False] * len(verifiers)
     remaining = list(range(len(verifiers)))  # tail = host-lane candidates
+    _t_begin = _time.monotonic()
+    stats = {
+        "batches": len(verifiers),
+        "sigs": sum(v.batch_size for v in verifiers),
+        "host_batches": 0,
+        "device_batches": 0,
+        "device_sick": False,
+        "seconds": 0.0,
+    }
+
+    def _finish(result):
+        stats["seconds"] = _time.monotonic() - _t_begin
+        last_run_stats.clear()
+        last_run_stats.update(stats)
+        return result
 
     def stage_one(i):
         try:
@@ -571,6 +612,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         decided[i] = 1
         t0 = _time.monotonic()
         staged = stage_one(i)
+        stats["host_batches"] += 1
         if staged is None:
             return
         check = staged.host_msm()
@@ -614,7 +656,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     if _time.monotonic() < _device_cooldown_until[0]:
         while remaining:
             host_verify_one(remaining.pop())
-        return verdicts
+        return _finish(verdicts)
     dev = _DeviceLane.get()
 
     ema_per_batch = 0.2  # seconds per batch; pessimistic prior
@@ -648,6 +690,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 if _time.monotonic() < deadline:
                     return progress
                 device_sick = True  # missed deadline
+                stats["device_sick"] = True
                 _device_cooldown_until[0] = _time.monotonic() + 30.0
                 dev.abandon()
                 for _, idxs2, _t in outstanding:
@@ -668,6 +711,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                     if decided[i]:
                         continue  # host stole this batch back first
                     decided[i] = 1
+                    stats["device_batches"] += 1
                     check = msm.combine_window_sums(out[j])
                     verdicts[i] = check.mul_by_cofactor().is_identity()
             progress = True
@@ -723,7 +767,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 poll(block=True)
         elif remaining:
             host_verify_one(remaining.pop())
-    return verdicts
+    return _finish(verdicts)
 
 
 class PendingVerification:
