@@ -651,6 +651,29 @@ struct ge8 {
     fe8 X, Y, Z, T;
 };
 
+// Addition of a cached ("Niels"-form) table entry N = (Y−X, Y+X, 2Z,
+// T·2d) to an extended point: 8 multiplies instead of 10, and no 2d
+// constant in the hot loop.
+IFMA_TARGET static void ge8_add_niels(ge8 &r, const ge8 &p, const fe8 &n0,
+                                      const fe8 &n1, const fe8 &n2,
+                                      const fe8 &n3) {
+    fe8 a, b, c, d, e, f, g, h, t0, t1;
+    fe8_sub(t0, p.Y, p.X);
+    fe8_mul(a, t0, n0);
+    fe8_add(t1, p.Y, p.X);
+    fe8_mul(b, t1, n1);
+    fe8_mul(c, p.T, n3);
+    fe8_mul(d, p.Z, n2);
+    fe8_sub(e, b, a);
+    fe8_sub(f, d, c);
+    fe8_add(g, d, c);
+    fe8_add(h, b, a);
+    fe8_mul(r.X, e, f);
+    fe8_mul(r.Y, g, h);
+    fe8_mul(r.Z, f, g);
+    fe8_mul(r.T, e, h);
+}
+
 IFMA_TARGET static void ge8_add(ge8 &r, const ge8 &p, const ge8 &q,
                                 const fe8 &d2) {
     fe8 a, b, c, d, e, f, g, h, t0, t1;
@@ -694,11 +717,16 @@ IFMA_TARGET static void table_build8(const uint8_t *points, u64 *tables) {
     }
 
     auto store_entry = [&](int k, const ge8 &e) {
+        // store in Niels form: (Y-X, Y+X, 2Z, T*2d)
+        fe8 n[4];
+        fe8_sub(n[0], e.Y, e.X);
+        fe8_add(n[1], e.Y, e.X);
+        fe8_add(n[2], e.Z, e.Z);
+        fe8_mul(n[3], e.T, d2);
         alignas(64) u64 lanes[5][8];
-        const fe8 *coords[4] = {&e.X, &e.Y, &e.Z, &e.T};
         for (int c = 0; c < 4; c++) {
             for (int i = 0; i < 5; i++)
-                _mm512_store_si512((__m512i *)lanes[i], coords[c]->v[i]);
+                _mm512_store_si512((__m512i *)lanes[i], n[c].v[i]);
             for (int l = 0; l < 8; l++)
                 for (int i = 0; i < 5; i++)
                     tables[320 * l + 20 * k + 5 * c + i] = lanes[i][l];
@@ -706,9 +734,12 @@ IFMA_TARGET static void table_build8(const uint8_t *points, u64 *tables) {
     };
 
     for (int l = 0; l < 8; l++) {
-        ge id;
-        ge_identity(id);
-        memcpy(tables + 320 * l, &id, 160);
+        // Niels identity: (1, 1, 2, 0)
+        u64 *row = tables + 320 * l;
+        memset(row, 0, 160);
+        row[0] = 1;
+        row[5] = 1;
+        row[10] = 2;
     }
     ge8 e = p;
     store_entry(1, e);
@@ -743,12 +774,17 @@ IFMA_TARGET static void table_build8_x2(const uint8_t *points,
     }
 
     auto store_entry = [&](int half, int k, const ge8 &e) {
+        // store in Niels form: (Y-X, Y+X, 2Z, T*2d)
         u64 *tbl = tables + 320 * 8 * half;
+        fe8 n[4];
+        fe8_sub(n[0], e.Y, e.X);
+        fe8_add(n[1], e.Y, e.X);
+        fe8_add(n[2], e.Z, e.Z);
+        fe8_mul(n[3], e.T, d2);
         alignas(64) u64 lanes[5][8];
-        const fe8 *coords[4] = {&e.X, &e.Y, &e.Z, &e.T};
         for (int c = 0; c < 4; c++) {
             for (int i = 0; i < 5; i++)
-                _mm512_store_si512((__m512i *)lanes[i], coords[c]->v[i]);
+                _mm512_store_si512((__m512i *)lanes[i], n[c].v[i]);
             for (int l = 0; l < 8; l++)
                 for (int i = 0; i < 5; i++)
                     tbl[320 * l + 20 * k + 5 * c + i] = lanes[i][l];
@@ -756,9 +792,12 @@ IFMA_TARGET static void table_build8_x2(const uint8_t *points,
     };
 
     for (int l = 0; l < 16; l++) {
-        ge id;
-        ge_identity(id);
-        memcpy(tables + 320 * l, &id, 160);
+        // Niels identity: (1, 1, 2, 0)
+        u64 *row = tables + 320 * l;
+        memset(row, 0, 160);
+        row[0] = 1;
+        row[5] = 1;
+        row[10] = 2;
     }
     ge8 ea = pa, eb = pb;
     store_entry(0, 1, ea);
@@ -827,17 +866,16 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
                 _mm512_set_epi64(d[7], d[6], d[5], d[4], d[3], d[2], d[1],
                                  d[0]),
                 twenty);
-            ge8 entry;
-            fe8 *coords[4] = {&entry.X, &entry.Y, &entry.Z, &entry.T};
+            fe8 n[4];
             for (int c = 0; c < 4; c++) {
                 for (int l = 0; l < 5; l++) {
                     __m512i off = _mm512_add_epi64(
                         idx, _mm512_set1_epi64(c * 5 + l));
-                    coords[c]->v[l] = _mm512_i64gather_epi64(
+                    n[c].v[l] = _mm512_i64gather_epi64(
                         off, (const long long *)base, 8);
                 }
             }
-            ge8_add(accs[g], accs[g], entry, d2);
+            ge8_add_niels(accs[g], accs[g], n[0], n[1], n[2], n[3]);
         }
     }
     for (int g = 0; g < 8; g++)
@@ -893,7 +931,10 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
         ge *tables = new ge[n * 16];
         uint64_t i0 = 0;
 #if defined(__x86_64__)
-        if (ifma_available()) {
+        // IFMA tables are stored in Niels form, readable only by the
+        // IFMA accumulation path (n >= 16); otherwise build scalar
+        // extended-form tables for the scalar Straus loop.
+        if (ifma_available() && n >= 16) {
             for (; i0 + 16 <= n; i0 += 16)
                 ifma::table_build8_x2(points + 128 * i0,
                                       (u64 *)(tables + 16 * i0));
@@ -902,6 +943,10 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
                                    (u64 *)(tables + 16 * i0));
         }
 #endif
+        bool niels_tables = false;
+#if defined(__x86_64__)
+        niels_tables = ifma_available() && n >= 16;
+#endif
         for (uint64_t i = i0; i < n; i++) {
             ge p;
             ge_frombytes128(p, points + 128 * i);
@@ -909,6 +954,19 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
             tables[16 * i + 1] = p;
             for (int j = 2; j < 16; j++)
                 ge_add(tables[16 * i + j], tables[16 * i + j - 1], p);
+            if (niels_tables) {
+                // Convert this point's entries to the Niels form the
+                // IFMA accumulation reads: (Y-X, Y+X, 2Z, T*2d).
+                for (int j = 0; j < 16; j++) {
+                    ge &e = tables[16 * i + j];
+                    ge nf;
+                    fe_sub(nf.X, e.Y, e.X);
+                    fe_add(nf.Y, e.Y, e.X);
+                    fe_add(nf.Z, e.Z, e.Z);
+                    fe_mul(nf.T, e.T, FE_2D);
+                    e = nf;
+                }
+            }
         }
 #if defined(__x86_64__)
         if (ifma_available() && n >= 16) {

@@ -120,3 +120,24 @@ def test_native_check_prehashed_parity():
         assert native.check_prehashed(minus_A, Rc, kc, sc) == python_check(
             minus_A, Rc, kc, sc
         )
+
+
+def test_native_msm_niels_boundary_parity():
+    """The IFMA accumulation reads Niels-form tables (n >= 16) while the
+    scalar Straus path reads extended-form ones — every n around the
+    8/16-point build boundaries must agree with the exact host MSM
+    (regression: a mixed-form tail at n % 8 != 0 read garbage)."""
+    import random
+
+    from ed25519_consensus_tpu import native
+    from ed25519_consensus_tpu.ops import edwards
+    from ed25519_consensus_tpu.ops.scalar import L
+
+    rng = random.Random(9)
+    for n in (2, 8, 15, 16, 17, 24, 33, 40):
+        pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, L))
+               for _ in range(n - 2)] + edwards.eight_torsion()[4:6]
+        sc = [rng.randrange(L) for _ in range(n)]
+        sc[0] = 0
+        assert native.vartime_msm(sc, pts) == \
+            edwards.multiscalar_mul(sc, pts), n
