@@ -922,10 +922,9 @@ extern "C" {
 //   scalars: n * 32 bytes, little-endian integers < 2^256
 //   points:  n * 128 bytes (X‖Y‖Z‖T canonical encodings)
 //   out:     128 bytes
-void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
-                         uint64_t n, uint8_t *out) {
-    ge acc;
-    ge_identity(acc);
+static void edwards_vartime_msm_chunk(const uint8_t *scalars,
+                                      const uint8_t *points, uint64_t n,
+                                      ge &acc) {
     if (n > 0) {
         // per-point tables: T[i][j] = [j] P_i, j = 0..15
         ge *tables = new ge[n * 16];
@@ -971,33 +970,55 @@ void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
 #if defined(__x86_64__)
         if (ifma_available() && n >= 16) {
             // 8-way transposed accumulation: 64 independent window sums,
-            // then a scalar Horner combine (MSB-first).
+            // then a scalar Horner combine (MSB-first) into a chunk-local
+            // accumulator folded into the running total.
             u64 *sums = new u64[64 * 20];
             ifma::straus_accumulate8((const u64 *)tables, scalars, n,
                                      sums);
+            ge hacc;
+            ge_identity(hacc);
             for (int w = 63; w >= 0; w--) {
                 if (w != 63)
-                    for (int k = 0; k < 4; k++) ge_double(acc, acc);
+                    for (int k = 0; k < 4; k++) ge_double(hacc, hacc);
                 ge s;
                 memcpy(&s, sums + 20 * w, 160);
-                ge_add(acc, acc, s);
+                ge_add(hacc, hacc, s);
             }
+            ge_add(acc, acc, hacc);
             delete[] sums;
             delete[] tables;
-            ge_tobytes128(out, acc);
             return;
         }
 #endif
+        ge chunk_acc;
+        ge_identity(chunk_acc);
         for (int w = 63; w >= 0; w--) {
             if (w != 63)
-                for (int k = 0; k < 4; k++) ge_double(acc, acc);
+                for (int k = 0; k < 4; k++) ge_double(chunk_acc, chunk_acc);
             int byte = w / 2, shift = (w & 1) ? 4 : 0;
             for (uint64_t i = 0; i < n; i++) {
                 int digit = (scalars[32 * i + byte] >> shift) & 15;
-                if (digit) ge_add(acc, acc, tables[16 * i + digit]);
+                if (digit)
+                    ge_add(chunk_acc, chunk_acc, tables[16 * i + digit]);
             }
         }
+        ge_add(acc, acc, chunk_acc);
         delete[] tables;
+    }
+}
+
+void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
+                         uint64_t n, uint8_t *out) {
+    // Chunk the MSM so each chunk's multiples tables (~2.5 KB/term) stay
+    // cache-resident for the gather-heavy accumulation: MSM(all) is just
+    // the Edwards sum of the chunk MSMs.
+    const uint64_t CHUNK = 10240;
+    ge acc;
+    ge_identity(acc);
+    for (uint64_t off = 0; off < n; off += CHUNK) {
+        uint64_t c = n - off < CHUNK ? n - off : CHUNK;
+        edwards_vartime_msm_chunk(scalars + 32 * off, points + 128 * off,
+                                  c, acc);
     }
     ge_tobytes128(out, acc);
 }

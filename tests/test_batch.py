@@ -91,3 +91,35 @@ def test_batch_rejects_malformed_key():
 
 def test_empty_batch_verifies():
     batch.Verifier().verify(rng=rng)
+
+
+def test_batch_verify_across_msm_chunk_boundary():
+    """The native MSM processes terms in cache-resident chunks of 10240;
+    a batch whose term count crosses that boundary must still verify (and
+    a tampered one must not)."""
+    import random
+
+    from ed25519_consensus_tpu import SigningKey, batch
+    from ed25519_consensus_tpu.error import InvalidSignature
+
+    rng = random.Random(0xC4C4E)
+    keys = [SigningKey.new(rng) for _ in range(8)]
+    bv = batch.Verifier()
+    n = 10_500  # > 10240 terms incl. coefficients
+    for i in range(n):
+        sk = keys[i % 8]
+        msg = b"chunk-boundary %d" % i
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    bv.verify(rng=rng, backend="host")
+
+    bv2 = batch.Verifier()
+    for i in range(n):
+        sk = keys[i % 8]
+        msg = b"chunk-boundary %d" % i
+        sig = sk.sign(msg if i != n - 7 else b"tampered")
+        bv2.queue((sk.verification_key_bytes(), sig, msg))
+    try:
+        bv2.verify(rng=rng, backend="host")
+        raise AssertionError("tampered batch verified")
+    except InvalidSignature:
+        pass
