@@ -847,6 +847,22 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
         ge8 *accs = (t & 1) ? acc2 : acc;
         const u64 *base = tables + 320 * t;
         const uint8_t *s = scalars + 32 * t;
+        // Prefetch the table entries the NEXT term's low 32 windows will
+        // gather.  Only the low half on purpose: the 128-bit blinder
+        // terms that dominate a staged batch have zero digits above
+        // window 31 (see the ngroups skip below), so prefetching the
+        // high half would double hint traffic for no common-case gain.
+        if (t + 1 < n) {
+            const u64 *nbase = tables + 320 * (t + 1);
+            const uint8_t *ns = scalars + 32 * (t + 1);
+            for (int w = 0; w < 32; w++) {
+                int d = (ns[w >> 1] >> ((w & 1) * 4)) & 15;
+                const char *line = (const char *)(nbase + 20 * d);
+                _mm_prefetch(line, _MM_HINT_T0);
+                _mm_prefetch(line + 64, _MM_HINT_T0);
+                _mm_prefetch(line + 128, _MM_HINT_T0);
+            }
+        }
         int dig[64];
         for (int w = 0; w < 64; w++)
             dig[w] = (s[w >> 1] >> ((w & 1) * 4)) & 15;
