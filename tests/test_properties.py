@@ -1,0 +1,70 @@
+"""Property-based tests (SURVEY §4 build mapping: hypothesis for the
+round-trip/property layer).
+
+The crown jewel is the decompression agreement property: the native C++
+path and the exact Python path must agree on ARBITRARY 32-byte input —
+any divergence is a consensus fork, not a bug."""
+
+import random
+
+from hypothesis import given, settings, strategies as st
+
+from ed25519_consensus_tpu import (InvalidSignature, Signature, SigningKey,
+                                   VerificationKeyBytes, native)
+from ed25519_consensus_tpu.ops import edwards
+
+bytes32 = st.binary(min_size=32, max_size=32)
+
+
+@settings(max_examples=200, deadline=None)
+@given(bytes32)
+def test_native_decompress_agrees_on_arbitrary_bytes(enc):
+    """Native and Python ZIP215 decompression must agree (accept/reject
+    AND the resulting point) on any 32-byte string."""
+    want = edwards.decompress(enc)
+    raw, ok = native.decompress_batch_buffer(enc, 1)
+    if want is None:
+        assert ok[0] == 0
+    else:
+        assert ok[0] == 1
+        assert native.point_from_raw(raw[0]) == want
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.binary(min_size=32, max_size=32), st.binary(max_size=96))
+def test_sign_verify_roundtrip(seed, msg):
+    sk = SigningKey.from_seed(seed)
+    sig = sk.sign(msg)
+    sk.verification_key().verify(sig, msg)
+    # byte round-trips of every wire type
+    assert SigningKey.from_bytes(bytes(sk)).to_bytes() == sk.to_bytes()
+    assert Signature.from_bytes(bytes(sig)).to_bytes() == sig.to_bytes()
+    vkb = sk.verification_key_bytes()
+    assert VerificationKeyBytes(bytes(vkb)).to_bytes() == vkb.to_bytes()
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.binary(min_size=32, max_size=32), st.binary(max_size=64),
+       st.integers(min_value=0, max_value=63))
+def test_tampered_bit_fails(seed, msg, bit):
+    """Flipping any bit of the 64-byte signature must fail verification
+    (either a malformed-encoding rejection or an invalid signature)."""
+    sk = SigningKey.from_seed(seed)
+    sig = bytearray(sk.sign(msg).to_bytes())
+    sig[bit] ^= 1 << (bit % 8)
+    try:
+        sk.verification_key().verify(Signature.from_bytes(bytes(sig)), msg)
+    except InvalidSignature:
+        return
+    raise AssertionError("tampered signature verified")
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.lists(st.integers(min_value=0, max_value=(1 << 253) - 1),
+                min_size=1, max_size=6),
+       st.randoms(use_true_random=False))
+def test_native_msm_matches_host(scalars, pyrandom):
+    pts = [edwards.BASEPOINT.scalar_mul(pyrandom.randrange(1, 2**200) | 1)
+           for _ in scalars]
+    assert native.vartime_msm(scalars, pts) == \
+        edwards.multiscalar_mul(scalars, pts)
