@@ -123,3 +123,23 @@ def test_batch_verify_across_msm_chunk_boundary():
         raise AssertionError("tampered batch verified")
     except InvalidSignature:
         pass
+
+
+def test_verify_many_edge_shapes():
+    """Empty list, empty batches, and single-signature batches through
+    verify_many."""
+    import random
+
+    from ed25519_consensus_tpu import SigningKey, batch
+
+    rng = random.Random(0xE9E)
+    assert batch.verify_many([], rng=rng) == []
+
+    empty = batch.Verifier()  # vacuously valid, like the reference
+    sk = SigningKey.new(rng)
+    one = batch.Verifier()
+    one.queue((sk.verification_key_bytes(), sk.sign(b"x"), b"x"))
+    bad = batch.Verifier()
+    bad.queue((sk.verification_key_bytes(), sk.sign(b"x"), b"y"))
+    assert batch.verify_many([empty, one, bad], rng=rng) == \
+        [True, True, False]
