@@ -239,6 +239,11 @@ def main():
                     [rebuild_fresh(bv) for _ in range(run_depth)], rng=rng
                 )
                 assert all(verdicts), "bench batch must verify"
+                s = batch_mod.last_run_stats
+                print(f"#   lanes: device {s.get('device_batches', 0)} / "
+                      f"host {s.get('host_batches', 0)} batches"
+                      + (" (device sick)" if s.get("device_sick") else ""),
+                      file=sys.stderr)
             else:
                 rebuild_fresh(bv).verify(rng=rng, backend=run_backend)
             dt = (time.time() - t0) / run_depth

@@ -175,10 +175,11 @@ class StagedBatch:
         return native.vartime_msm_scblob(sblob, self.raw_points)
 
     def device_operands(self, pad_fn):
-        """Build the padded (digits (32, N) int32, points (4, NLIMBS, N)
-        int32) device operands: coefficients split into 128-bit chunks
-        against their shift points, blinder digits and point limbs packed
-        vectorized from the raw buffers."""
+        """Build the padded device operands — signed digit planes
+        (NWINDOWS, N) int8 and point limbs (4, NLIMBS, N) int16:
+        coefficients split into 128-bit chunks against their shift
+        points, blinder digits and point limbs packed vectorized from
+        the raw buffers."""
         from .ops import limbs
 
         mask = (1 << 128) - 1
