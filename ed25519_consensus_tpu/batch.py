@@ -425,6 +425,9 @@ class Verifier:
 # launches for tens of seconds — retrying it every call is ruinous).
 _device_cooldown_until = [0.0]
 _device_lane_stuck = [False]
+# After a call where the probe completed but the device won zero batches,
+# skip probing for a while (the probe costs real host time every call).
+_device_uncompetitive_until = [0.0]
 
 # Observability (SURVEY.md §5): counters for the most recent verify_many
 # call — batch/signature totals, the device/host lane split, and wall
@@ -594,6 +597,10 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
 
     def _finish(result):
         stats["seconds"] = _time.monotonic() - _t_begin
+        if (stats["batches"] >= 8 and stats["device_batches"] == 0
+                and not stats["device_sick"] and stats["host_batches"]):
+            # the device lost every race this call: pause probing
+            _device_uncompetitive_until[0] = _time.monotonic() + 60.0
         last_run_stats.clear()
         last_run_stats.update(stats)
         return result
@@ -654,7 +661,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # sick: its batches are re-verified on the host — identical exact math
     # decides the verdict either way — and later calls skip the device
     # for a cooldown period.
-    if _time.monotonic() < _device_cooldown_until[0]:
+    if (_time.monotonic() < _device_cooldown_until[0]
+            or _time.monotonic() < _device_uncompetitive_until[0]):
         while remaining:
             host_verify_one(remaining.pop())
         return _finish(verdicts)
