@@ -436,11 +436,6 @@ last_run_stats = {}
 
 _PENDING = object()
 
-# All device-side calls from every lane go through this lock: the PJRT
-# client must never be entered concurrently, including by a worker that was
-# abandoned mid-stall and later wakes up.
-_DEVICE_CALL_LOCK = None
-
 
 class _DeviceLane:
     """The device lane: ONE worker thread serializing every device call
@@ -461,12 +456,10 @@ class _DeviceLane:
         import queue
         import threading
 
-        global _DEVICE_CALL_LOCK
-        if _DEVICE_CALL_LOCK is None:
-            _DEVICE_CALL_LOCK = threading.Lock()
         self._q = queue.Queue()
         self._results = {}
         self._discarded = set()
+        self._started = {}  # cid -> monotonic time the device call began
         self._cv = threading.Condition()
         self._next_id = 0
         self._abandoned = False
@@ -488,10 +481,18 @@ class _DeviceLane:
         """Caller no longer wants this result (it decided on the host);
         drop it on arrival instead of leaking it."""
         with self._cv:
+            self._started.pop(cid, None)
             if cid in self._results:
                 del self._results[cid]
             else:
                 self._discarded.add(cid)
+
+    def started_at(self, cid: int):
+        """Monotonic time the worker ENTERED the device call for `cid`, or
+        None while it is still queued (e.g. behind another chunk or a
+        direct caller holding the device-call lock)."""
+        with self._cv:
+            return self._started.get(cid)
 
     def wait(self, cid: int, timeout: float):
         """Result array, None (device error), or _PENDING on timeout."""
@@ -527,14 +528,21 @@ class _DeviceLane:
             if item is None:
                 return
             cid, digits, pts = item
+            import time as _time
+
             try:
-                with _DEVICE_CALL_LOCK:
+                # One critical section across launch + blocking fetch (the
+                # lock is reentrant; ops.msm's dispatch re-acquires it).
+                with _msm.DEVICE_CALL_LOCK:
+                    with self._cv:
+                        self._started[cid] = _time.monotonic()
                     out = np.asarray(
                         _msm.dispatch_window_sums_many(digits, pts)
                     )
             except Exception:  # device error: caller decides on host
                 out = None
             with self._cv:
+                self._started.pop(cid, None)
                 if cid in self._discarded:
                     self._discarded.discard(cid)
                 else:
@@ -672,6 +680,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     ema_is_prior = True
     outstanding = []  # [(chunk_id, idxs, t_submit)]
     device_sick = False
+    device_failed = False  # an error chunk: stop using the device this call
 
     def submit(size=None):
         size = chunk if size is None else size
@@ -687,15 +696,25 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     def poll(block: bool):
         """Apply finished chunk results; returns True if progress.  On a
         deadline miss, fail the device over to the host."""
-        nonlocal device_sick, ema_per_batch, ema_is_prior
+        nonlocal device_sick, device_failed, ema_per_batch, ema_is_prior
         progress = False
         while outstanding:
             cid, idxs, t0 = outstanding[0]
-            deadline = t0 + max(3.0 * ema_per_batch * len(idxs), 2.0)
+            budget = max(3.0 * ema_per_batch * len(idxs), 2.0)
+            # The deadline clocks the device CALL, not queue time: while
+            # the chunk waits behind another chunk or a direct caller
+            # holding the device-call lock, allow a bounded extra wait
+            # instead of falsely marking a healthy device sick.
+            t_start = dev.started_at(cid)
+            deadline = (t_start + budget) if t_start is not None \
+                else (t0 + budget + 10.0)
             timeout = max(0.0, deadline - _time.monotonic()) if block \
                 else 0.0
             out = dev.wait(cid, timeout)
             if out is _PENDING:
+                t_start = dev.started_at(cid)
+                deadline = (t_start + budget) if t_start is not None \
+                    else (t0 + budget + 10.0)
                 if _time.monotonic() < deadline:
                     return progress
                 device_sick = True  # missed deadline
@@ -708,14 +727,16 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 outstanding.clear()
                 return True
             outstanding.pop(0)
-            per_batch = (_time.monotonic() - t0) / max(1, len(idxs))
-            ema_per_batch = per_batch if ema_is_prior else (
-                0.6 * ema_per_batch + 0.4 * per_batch)
-            ema_is_prior = False
-            if out is None:  # device error: decide on host
+            if out is None:  # device error: host decides, device benched
+                device_failed = True  # don't trust an error turnaround as
+                #                       a competitive EMA measurement
                 for i in idxs:
                     host_verify_one(i)
             else:
+                per_batch = (_time.monotonic() - t0) / max(1, len(idxs))
+                ema_per_batch = per_batch if ema_is_prior else (
+                    0.6 * ema_per_batch + 0.4 * per_batch)
+                ema_is_prior = False
                 for j, i in enumerate(idxs):
                     if decided[i]:
                         continue  # host stole this batch back first
@@ -743,7 +764,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if remaining and not outstanding and not probed:
             submit(size=min(2, chunk))  # cheap probe: 2 batches
             probed = True
-        while (remaining and outstanding and len(outstanding) < 2
+        while (remaining and len(outstanding) < 2 and not device_failed
                and not ema_is_prior and device_competitive()):
             submit()
         poll(block=False)

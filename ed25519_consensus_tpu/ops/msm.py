@@ -48,12 +48,20 @@ integers, and returns the host Point.  All accept/reject logic stays on the
 host (batch.py)."""
 
 import functools
+import threading
 
 import numpy as np
 
 from . import limbs
 from .edwards import Point, shift128
 from .limbs import NLIMBS
+
+# Every entry into the device runtime (launch or blocking fetch) holds this
+# lock: the PJRT client must never be entered from two threads at once
+# (batch._DeviceLane's worker vs. callers using verify/verify_async
+# directly).  Reentrant so the lane worker can hold it across a
+# dispatch + fetch critical section.
+DEVICE_CALL_LOCK = threading.RLock()
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
 
@@ -228,7 +236,9 @@ class PendingMSM:
         self._dev_out = dev_out
 
     def result(self) -> Point:
-        return combine_window_sums(np.asarray(self._dev_out))
+        with DEVICE_CALL_LOCK:  # blocking D2H fetch enters the client
+            out = np.asarray(self._dev_out)
+        return combine_window_sums(out)
 
 
 def _use_pallas() -> bool:
@@ -276,17 +286,18 @@ def dispatch_window_sums_many(digits, points):
     """One device call for B stacked batches: digits (B, NWINDOWS, N),
     points (B, 4, NLIMBS, N) numpy → (B, 4, NLIMBS, NWINDOWS) device array
     with its D2H copy in flight."""
-    if _use_pallas():
-        from . import pallas_msm
+    with DEVICE_CALL_LOCK:
+        if _use_pallas():
+            from . import pallas_msm
 
-        out = pallas_msm.pallas_window_sums_many(digits, points)
-    else:
-        out = _compiled_kernel_many(digits.shape[0], digits.shape[2],
-                                    digits.shape[1])(digits, points)
-    try:
-        out.copy_to_host_async()
-    except AttributeError:
-        pass
+            out = pallas_msm.pallas_window_sums_many(digits, points)
+        else:
+            out = _compiled_kernel_many(digits.shape[0], digits.shape[2],
+                                        digits.shape[1])(digits, points)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
     return out
 
 

@@ -95,6 +95,14 @@ def sharded_window_sums(digits, pts, n_devices: int):
     return kernel(digits, pts)
 
 
+def _locked_fold(digits, pts, n_devices: int) -> Point:
+    """Dispatch + fetch under the device-call lock (the PJRT client must
+    never be entered concurrently), then exact host Horner combine."""
+    with msm_lib.DEVICE_CALL_LOCK:
+        out = np.asarray(sharded_window_sums(digits, pts, n_devices))
+    return msm_lib.combine_window_sums(out)
+
+
 def sharded_device_msm(scalars, points, n_devices: int | None = None,
                        shifts=None) -> Point:
     """Exact Σ[c_i]P_i sharded over `n_devices` (default: all devices).
@@ -109,8 +117,7 @@ def sharded_device_msm(scalars, points, n_devices: int | None = None,
     scalars, points = msm_lib.split_terms(scalars, points, shifts)
     N = _shard_pad(len(scalars), n_devices)
     digits, pts = msm_lib.pack_msm_operands(scalars, points, n_lanes=N)
-    out = np.asarray(sharded_window_sums(digits, pts, n_devices))
-    return msm_lib.combine_window_sums(out)
+    return _locked_fold(digits, pts, n_devices)
 
 
 def sharded_staged_msm(staged, n_devices: int | None = None) -> Point:
@@ -122,5 +129,4 @@ def sharded_staged_msm(staged, n_devices: int | None = None) -> Point:
     digits, pts = staged.device_operands(
         lambda n: _shard_pad(n, n_devices)
     )
-    out = np.asarray(sharded_window_sums(digits, pts, n_devices))
-    return msm_lib.combine_window_sums(out)
+    return _locked_fold(digits, pts, n_devices)

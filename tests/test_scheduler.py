@@ -1,0 +1,270 @@
+"""The verify_many work-stealing/failure scheduler (batch.py).
+
+The reference's failure model is adversarial *input* only (all-or-nothing
+batches + per-item fallback, reference src/batch.rs:96-108,139-147); this
+build adds a failure model for the *device*: a remote-attached TPU can
+error, stall, or simply lose the throughput race, and none of that may
+change a verdict.  These tests drive every branch of that machinery by
+monkeypatching the device dispatch function — verdicts are always decided
+by the same exact host math, so each test asserts both the scheduling
+behavior (stats/cooldowns) and verdict correctness.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from ed25519_consensus_tpu import SigningKey, batch
+from ed25519_consensus_tpu.ops import msm
+
+rng = random.Random(0x5C4ED)
+
+
+@pytest.fixture(autouse=True)
+def reset_device_state():
+    """Reset the module-level scheduler state (cooldowns, lane singleton)
+    so tests are order-independent."""
+    yield
+    inst = batch._DeviceLane._instance
+    if inst is not None and inst.healthy():
+        inst.shutdown(timeout=5.0)
+    batch._DeviceLane._instance = None
+    batch._device_cooldown_until[0] = 0.0
+    batch._device_uncompetitive_until[0] = 0.0
+    batch._device_lane_stuck[0] = False
+    batch.last_run_stats.clear()
+
+
+def make_verifiers(n_batches, sigs_per_batch=3, bad=()):
+    """n_batches independent Verifiers; indices in `bad` get one corrupted
+    signature."""
+    out = []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        for i in range(sigs_per_batch):
+            sk = SigningKey.new(rng)
+            msg = b"scheduler-%d-%d" % (b, i)
+            sig = sk.sign(msg if (b not in bad or i != 0) else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        out.append(v)
+    return out
+
+
+def expected(n_batches, bad=()):
+    return [i not in bad for i in range(n_batches)]
+
+
+def warm_kernel_cache():
+    """Pre-compile the (CPU backend) device kernel for the chunk shapes the
+    tests dispatch, so a cold first jit compile (~seconds) can't eat the
+    2 s probe deadline and flip device_sick — that would test warmup, not
+    the scheduler."""
+    import numpy as np
+
+    from ed25519_consensus_tpu.ops import limbs
+
+    n_lanes = msm.preferred_pad(11)  # 3 sigs + 4 coeffs + 4 split-highs
+    for nb in (1, 2):
+        digits = np.zeros((nb, limbs.NWINDOWS, n_lanes), dtype=np.int8)
+        pts = np.stack([limbs.identity_point_batch(n_lanes)] * nb)
+        np.asarray(msm.dispatch_window_sums_many(digits, pts))
+
+
+def test_device_error_falls_back_to_host(monkeypatch):
+    """A device dispatch that raises → lane reports None → every batch is
+    re-decided on the host; verdicts unaffected."""
+
+    def boom(digits, pts):
+        raise RuntimeError("injected device error")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
+    vs = make_verifiers(6, bad={2})
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    assert verdicts == expected(6, bad={2})
+    stats = batch.last_run_stats
+    assert stats["device_batches"] == 0
+    assert stats["host_batches"] == 6
+    # an error is not a stall: no deadline cooldown, lane not abandoned
+    assert not stats["device_sick"]
+    assert not batch.device_lane_stuck()
+
+
+def test_error_chunk_benches_device_for_the_call(monkeypatch):
+    """An error chunk must BENCH the device for the rest of the call (no
+    EMA update from an error turnaround): a fast-failing device must not
+    measure as 'competitive' and consume every batch."""
+    warm_kernel_cache()
+    calls = []
+
+    def boom(digits, pts):
+        calls.append(digits.shape[0])
+        raise RuntimeError("fast-failing device")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
+    # Slow the host so a (bogus) fast-error EMA would win the competitive
+    # check if it were (incorrectly) recorded.
+    real_host_msm = batch.StagedBatch.host_msm
+
+    def slow_host_msm(self):
+        time.sleep(0.05)
+        return real_host_msm(self)
+
+    monkeypatch.setattr(batch.StagedBatch, "host_msm", slow_host_msm)
+    vs = make_verifiers(10, bad={3})
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    assert verdicts == expected(10, bad={3})
+    # exactly the probe reached the device; everything else stayed host
+    assert len(calls) == 1
+    assert batch.last_run_stats["host_batches"] == 10
+
+
+def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
+    """A stalled device call (tunnel seizure) must miss its deadline, mark
+    the device sick, re-verify its batches on the host, abandon the lane,
+    and start the cooldown."""
+    release = threading.Event()
+
+    def stall(digits, pts):
+        release.wait(timeout=30.0)
+        raise RuntimeError("stalled call never completes")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", stall)
+    # hybrid=False: the host lane must NOT race/overtake the chunk (with
+    # hybrid on, the host overtakes a stalled probe long before the
+    # deadline — by design), so the blocking poll hits the deadline.
+    vs = make_verifiers(5, bad={0})
+    t0 = time.monotonic()
+    try:
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False)
+    finally:
+        release.set()  # let the abandoned worker die promptly
+    assert verdicts == expected(5, bad={0})
+    stats = batch.last_run_stats
+    assert stats["device_sick"]
+    assert stats["device_batches"] == 0
+    assert stats["host_batches"] == 5
+    assert batch.device_lane_stuck()
+    assert batch._device_cooldown_until[0] > t0  # cooldown armed
+    # the sick lane was abandoned: a fresh get() builds a new one
+    assert batch._DeviceLane._instance is None
+
+
+def test_cooldown_skips_device_entirely(monkeypatch):
+    """While the health cooldown is armed, verify_many must not touch the
+    device lane at all."""
+    batch._device_cooldown_until[0] = time.monotonic() + 60.0
+
+    def fail_get(cls):
+        raise AssertionError("device lane used during cooldown")
+
+    monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
+    vs = make_verifiers(4, bad={3})
+    assert batch.verify_many(vs, rng=rng) == expected(4, bad={3})
+    assert batch.last_run_stats["host_batches"] == 4
+
+
+def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
+    """A working-but-slow device that wins zero batches in a call of ≥8
+    batches arms the uncompetitive pause; the next call skips probing."""
+    warm_kernel_cache()
+    real_dispatch = msm.dispatch_window_sums_many
+
+    def slow(digits, pts):
+        time.sleep(0.75)  # way above the host's per-batch time, < deadline
+        return real_dispatch(digits, pts)
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", slow)
+    vs = make_verifiers(10, bad={1})
+    t0 = time.monotonic()
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    assert verdicts == expected(10, bad={1})
+    stats = dict(batch.last_run_stats)
+    assert not stats["device_sick"]
+    # the host (ms per batch) always overtakes a 0.75 s device probe
+    assert stats["device_batches"] == 0
+    assert batch._device_uncompetitive_until[0] > t0
+    # second call: pure host, no lane contact
+
+    def fail_get(cls):
+        raise AssertionError("probed during uncompetitive pause")
+
+    monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
+    vs2 = make_verifiers(4)
+    assert batch.verify_many(vs2, rng=rng) == expected(4)
+
+
+def test_host_overtake_discards_inflight_chunk(monkeypatch):
+    """When the pool drains while a chunk is in flight, the host races it;
+    a fully-overtaken chunk is discarded (its late result is dropped)."""
+    release = threading.Event()
+    real_dispatch = msm.dispatch_window_sums_many
+
+    def gated(digits, pts):
+        release.wait(timeout=30.0)
+        return real_dispatch(digits, pts)
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", gated)
+    discards = []
+    orig_discard = batch._DeviceLane.discard
+
+    def spy_discard(self, cid):
+        discards.append(cid)
+        return orig_discard(self, cid)
+
+    monkeypatch.setattr(batch._DeviceLane, "discard", spy_discard)
+    vs = make_verifiers(4, bad={2})
+    try:
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    finally:
+        release.set()
+    assert verdicts == expected(4, bad={2})
+    stats = batch.last_run_stats
+    assert stats["host_batches"] == 4
+    assert stats["device_batches"] == 0
+    assert discards  # the gated probe chunk was overtaken and dropped
+    # the dropped result must not leak into the lane's result map
+    lane = batch._DeviceLane._instance
+    release.set()
+    deadline = time.monotonic() + 10.0
+    while lane._discarded and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not lane._results
+
+
+def test_competitive_device_wins_more_than_probe(monkeypatch):
+    """ADVICE round-1 regression: once the probe measures a competitive
+    device, follow-up chunks must keep flowing — the device lane must be
+    able to win MORE than the 2-batch probe in one call."""
+
+    warm_kernel_cache()
+    # Make the host lane artificially slow so the (CPU-backed) device
+    # kernel measures as competitive and keeps receiving chunks.
+    real_host_msm = batch.StagedBatch.host_msm
+
+    def slow_host_msm(self):
+        time.sleep(0.25)
+        return real_host_msm(self)
+
+    monkeypatch.setattr(batch.StagedBatch, "host_msm", slow_host_msm)
+    vs = make_verifiers(12, bad={5})
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    assert verdicts == expected(12, bad={5})
+    stats = batch.last_run_stats
+    assert stats["device_batches"] > 2, (
+        "competitive device stuck at the probe: pipeline gate regressed "
+        f"(stats={stats})"
+    )
+
+
+def test_verify_many_all_host_when_no_device_needed():
+    """Sanity: the scheduler path with the real (CPU backend) kernel ends
+    with every batch decided exactly once."""
+    vs = make_verifiers(9, bad={4, 7})
+    verdicts = batch.verify_many(vs, rng=rng, chunk=3)
+    assert verdicts == expected(9, bad={4, 7})
+    stats = batch.last_run_stats
+    assert stats["host_batches"] + stats["device_batches"] >= 9
+    assert stats["batches"] == 9
+    assert stats["sigs"] == 27
