@@ -235,6 +235,38 @@ class Verifier:
         )
         self.batch_size += 1
 
+    def queue_bulk(self, entries) -> None:
+        """Queue many `(vk_bytes, sig, msg)` entries with ONE native call
+        for all the challenge hashes k = H(R‖A‖msg) (the per-item work the
+        reference does at queue time, src/batch.rs:85-91).  Semantically
+        identical to `queue` in a loop — same coalescing map, same eager
+        challenge computation — but ~2× cheaper per signature on hot
+        streams.  Falls back to the per-item path without the native
+        library."""
+        entries = entries if isinstance(entries, list) else list(entries)
+        if not entries:
+            return
+        from . import native
+
+        vkbs, sigs, msgs, ra_parts = [], [], [], []
+        for vkb, sig, msg in entries:
+            if not isinstance(vkb, VerificationKeyBytes):
+                vkb = VerificationKeyBytes(vkb)
+            vkbs.append(vkb)
+            sigs.append(sig)
+            msgs.append(msg)
+            ra_parts.append(sig.R_bytes)
+            ra_parts.append(vkb.to_bytes())
+        ks = native.bulk_challenges(b"".join(ra_parts), msgs)
+        if ks is NotImplemented:
+            for vkb, sig, msg in zip(vkbs, sigs, msgs):
+                self.queue(Item.new(vkb, sig, msg))
+            return
+        sd = self.signatures.setdefault
+        for vkb, sig, k in zip(vkbs, sigs, ks):
+            sd(vkb, []).append((k, sig))
+        self.batch_size += len(entries)
+
     # -- staging (host, exact) --------------------------------------------
 
     def _stage(self, rng) -> "StagedBatch":
@@ -495,7 +527,8 @@ class _DeviceLane:
             return self._started.get(cid)
 
     def wait(self, cid: int, timeout: float):
-        """Result array, None (device error), or _PENDING on timeout."""
+        """(result array or None on device error, call_seconds) tuple, or
+        _PENDING on timeout."""
         import time as _time
 
         end = _time.monotonic() + timeout
@@ -530,23 +563,30 @@ class _DeviceLane:
             cid, digits, pts = item
             import time as _time
 
+            t_call = None
             try:
                 # One critical section across launch + blocking fetch (the
                 # lock is reentrant; ops.msm's dispatch re-acquires it).
                 with _msm.DEVICE_CALL_LOCK:
+                    t_call = _time.monotonic()
                     with self._cv:
-                        self._started[cid] = _time.monotonic()
+                        self._started[cid] = t_call
                     out = np.asarray(
                         _msm.dispatch_window_sums_many(digits, pts)
                     )
             except Exception:  # device error: caller decides on host
                 out = None
+            # Report the CALL duration (lock acquired → fetch done), not
+            # submit-to-finish: with 2 chunks pipelined, queue time would
+            # inflate the turnaround EMA ~2× and bench a healthy device.
+            call_dt = (_time.monotonic() - t_call) if t_call is not None \
+                else 0.0
             with self._cv:
                 self._started.pop(cid, None)
                 if cid in self._discarded:
                     self._discarded.discard(cid)
                 else:
-                    self._results[cid] = out
+                    self._results[cid] = (out, call_dt)
                 self._cv.notify_all()
 
 
@@ -569,14 +609,90 @@ def device_lane_stuck() -> bool:
     return _device_lane_stuck[0]
 
 
+# Union-merge policy (verify_many): batches whose average size is at most
+# _MERGE_MAX_BATCH are aggregated into super-batches of about
+# _MERGE_TARGET_SIGS signatures before verification.  The big-batch MSM
+# amortizes per-batch fixed costs (blinder draw, Horner combine, cofactor
+# check) AND coalesces recurring keys ACROSS batches — a CometBFT vote
+# stream (same validator set every height) collapses to the large-batch
+# shape.  Soundness is per-signature: every signature keeps its own
+# 128-bit blinder, so a valid union implies every member batch is valid
+# with the same 2^-128 error bound as the reference's single-batch check
+# (reference src/batch.rs:149-217); a failed union falls back to
+# bisection.
+_MERGE_TARGET_SIGS = 8192
+_MERGE_MAX_BATCH = 2048
+
+
+def merge_verifiers(group) -> "Verifier":
+    """One union Verifier over many (grouping by key coalesces across
+    batches; challenges were computed at queue time, so merging is pure
+    dict work — no re-hashing)."""
+    u = Verifier()
+    for v in group:
+        for vkb, sigs in v.signatures.items():
+            u.signatures.setdefault(vkb, []).extend(sigs)
+        u.batch_size += v.batch_size
+    return u
+
+
+def _host_verdict(verifier, rng) -> bool:
+    try:
+        verifier.verify(rng=rng, backend="host")
+        return True
+    except InvalidSignature:
+        return False
+
+
+def _resolve_union(verifiers, idxs, verdicts, rng):
+    """A union failed: bisect its member batches.  Each level re-verifies
+    a half-union with fresh blinders (host path — failures are the rare
+    case), so sparse bad batches cost O(bad · log(members))."""
+    if len(idxs) == 1:
+        verdicts[idxs[0]] = _host_verdict(verifiers[idxs[0]], rng)
+        return
+    mid = len(idxs) // 2
+    for half in (idxs[:mid], idxs[mid:]):
+        if _host_verdict(merge_verifiers([verifiers[i] for i in half]),
+                         rng):
+            for i in half:
+                verdicts[i] = True
+        else:
+            _resolve_union(verifiers, half, verdicts, rng)
+
+
+def _merge_groups(verifiers):
+    """Greedy grouping of batch indices into super-batches of about
+    _MERGE_TARGET_SIGS signatures (always ≥ 1 batch per group)."""
+    groups, cur, cur_sigs = [], [], 0
+    for i, v in enumerate(verifiers):
+        cur.append(i)
+        cur_sigs += v.batch_size
+        if cur_sigs >= _MERGE_TARGET_SIGS:
+            groups.append(cur)
+            cur, cur_sigs = [], 0
+    if cur:
+        groups.append(cur)
+    return groups
+
+
 def verify_many(verifiers, rng=None, chunk: int = 8,
-                hybrid: bool = True) -> "list[bool]":
-    """Verify MANY independent batches with chunked, double-buffered
-    device calls plus an opportunistic host lane.
+                hybrid: bool = True, merge: str = "auto") -> "list[bool]":
+    """Verify MANY independent batches with union-merging, chunked
+    double-buffered device calls, and an opportunistic host lane.
+
+    Small batches are first union-merged into ~_MERGE_TARGET_SIGS-sig
+    super-batches (`merge`: "auto" merges when the average batch is small,
+    "never" disables, "always" forces) — THE path for consensus vote
+    streams, where per-batch fixed costs and the recurring validator keys
+    dominate.  A valid union decides every member batch True at the
+    standard 2^-128 soundness bound; a failed union is bisected, so the
+    all-valid fast path costs one big MSM and adversarial streams degrade
+    to O(bad·log n) extra host work.
 
     On a remote-attached TPU the per-call round-trip dominates a batch's
-    device cost, so batches are stacked `chunk` at a time behind one
-    batched kernel launch — and because the launches are async, host
+    device cost, so (super-)batches are stacked `chunk` at a time behind
+    one batched kernel launch — and because the launches are async, host
     staging of chunk i+1 overlaps device compute of chunk i.  While a
     device chunk is still in flight after the next chunk is staged, the
     otherwise-idle host core verifies further batches end-to-end with the
@@ -591,6 +707,47 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     from .ops import msm
 
     verifiers = list(verifiers)
+    if merge not in ("auto", "never", "always"):
+        raise ValueError(f"unknown merge policy {merge!r}")
+    do_merge = merge == "always" or (
+        merge == "auto"
+        and len(verifiers) >= 2
+        and sum(v.batch_size for v in verifiers)
+        <= _MERGE_MAX_BATCH * len(verifiers)
+    )
+    if do_merge:
+        groups = _merge_groups(verifiers)
+        if len(groups) < len(verifiers):
+            unions = [merge_verifiers([verifiers[i] for i in g])
+                      for g in groups]
+            t0 = _time.monotonic()
+            union_verdicts = verify_many(
+                unions, rng=rng, chunk=chunk, hybrid=hybrid, merge="never"
+            )
+            stats = dict(last_run_stats)
+            verdicts = [False] * len(verifiers)
+            for g, ok in zip(groups, union_verdicts):
+                if ok:
+                    for i in g:
+                        verdicts[i] = True
+                else:
+                    _resolve_union(verifiers, g, verdicts, rng)
+            # Lane counters from the inner call are in UNION units; expose
+            # them as *_unions and drop the per-batch lane keys rather
+            # than report a misleadingly tiny host/device split over
+            # member batches.
+            stats.update(
+                batches=len(verifiers),
+                sigs=sum(v.batch_size for v in verifiers),
+                merged_unions=len(groups),
+                host_unions=stats.pop("host_batches", 0),
+                device_unions=stats.pop("device_batches", 0),
+                seconds=_time.monotonic() - t0,
+            )
+            last_run_stats.clear()
+            last_run_stats.update(stats)
+            return verdicts
+
     verdicts = [False] * len(verifiers)
     remaining = list(range(len(verifiers)))  # tail = host-lane candidates
     _t_begin = _time.monotonic()
@@ -669,8 +826,14 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # sick: its batches are re-verified on the host — identical exact math
     # decides the verdict either way — and later calls skip the device
     # for a cooldown period.
-    if (_time.monotonic() < _device_cooldown_until[0]
+    import os as _os
+
+    if (_os.environ.get("ED25519_TPU_DISABLE_DEVICE")
+            or _time.monotonic() < _device_cooldown_until[0]
             or _time.monotonic() < _device_uncompetitive_until[0]):
+        # ED25519_TPU_DISABLE_DEVICE: config knob (SURVEY.md §5) forcing
+        # the pure-host lane — also keeps jax entirely unloaded, which on
+        # small hosts frees a measurable slice of the core.
         while remaining:
             host_verify_one(remaining.pop())
         return _finish(verdicts)
@@ -710,8 +873,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 else (t0 + budget + 10.0)
             timeout = max(0.0, deadline - _time.monotonic()) if block \
                 else 0.0
-            out = dev.wait(cid, timeout)
-            if out is _PENDING:
+            res = dev.wait(cid, timeout)
+            if res is _PENDING:
                 t_start = dev.started_at(cid)
                 deadline = (t_start + budget) if t_start is not None \
                     else (t0 + budget + 10.0)
@@ -727,13 +890,17 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 outstanding.clear()
                 return True
             outstanding.pop(0)
+            out, call_dt = res
             if out is None:  # device error: host decides, device benched
                 device_failed = True  # don't trust an error turnaround as
                 #                       a competitive EMA measurement
                 for i in idxs:
                     host_verify_one(i)
             else:
-                per_batch = (_time.monotonic() - t0) / max(1, len(idxs))
+                # EMA over the device CALL time (the lane worker measures
+                # it) — queue time behind a pipelined sibling chunk is not
+                # device cost.
+                per_batch = call_dt / max(1, len(idxs))
                 ema_per_batch = per_batch if ema_is_prior else (
                     0.6 * ema_per_batch + 0.4 * per_batch)
                 ema_is_prior = False
@@ -798,6 +965,52 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         elif remaining:
             host_verify_one(remaining.pop())
     return _finish(verdicts)
+
+
+def verify_single_many(entries, rng=None) -> "list[bool]":
+    """Per-SIGNATURE verdicts for many independent (vk_bytes, sig, msg)
+    entries at batch-verification speed (reference users call
+    `VerificationKey::verify` in a loop for this,
+    src/verification_key.rs:225-233; ~100µs each).
+
+    Each entry becomes a one-signature batch; verify_many union-merges
+    them into one RLC equation (one native challenge-hash call + one big
+    MSM for the all-valid case) and bisects failures — so verdicts are
+    exactly the per-signature ZIP215 accept/reject decisions, ~20×
+    cheaper per signature than the per-call path on all-valid streams.
+    Soundness per entry is the same 2^-128 RLC bound as the reference's
+    batch verifier; a malformed entry (bad point encoding, s ≥ ℓ,
+    wrong-length bytes) is verdict False, never an exception."""
+    entries = list(entries)
+    staging = Verifier()  # challenge-hash all entries in ONE native call
+    cleaned = []
+    for vkb, sig, msg in entries:
+        try:
+            if not isinstance(vkb, VerificationKeyBytes):
+                vkb = VerificationKeyBytes(vkb)
+            if not isinstance(sig, Signature):
+                sig = Signature.from_bytes(sig)
+            cleaned.append((vkb, sig, msg))
+        except Exception:
+            cleaned.append(None)  # malformed wire bytes: verdict False
+    staging.queue_bulk([e for e in cleaned if e is not None])
+    # queue_bulk grouped by key in entry order, so per-key iterators hand
+    # each entry its own (k, sig) back in order.
+    by_key = {vkb: iter(ksigs)
+              for vkb, ksigs in staging.signatures.items()}
+    verifiers = []
+    poison = [(0, Signature(b"\xff" * 32, b"\xff" * 32))]
+    for e in cleaned:
+        v = Verifier()
+        v.batch_size = 1
+        if e is None:
+            # s = ff…ff ≥ ℓ: guaranteed staging rejection → verdict False
+            v.signatures[VerificationKeyBytes(b"\xff" * 32)] = poison
+        else:
+            vkb = e[0]
+            v.signatures[vkb] = [next(by_key[vkb])]
+        verifiers.append(v)
+    return verify_many(verifiers, rng=rng, merge="always")
 
 
 class PendingVerification:

@@ -86,6 +86,11 @@ def load():
             ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.stage_scalars.restype = ctypes.c_int
+        lib.bulk_challenges.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.bulk_challenges.restype = None
         _self_check(lib)
         _lib = lib
     except Exception:
@@ -156,6 +161,22 @@ def _self_check(lib):
     )
     if not ok or bad:
         raise RuntimeError("native check_prehashed disagreement")
+    # bulk_challenges: SHA-512 + wide reduction must match hashlib +
+    # Python from_hash on a multi-length message mix (incl. one spanning
+    # several 128-byte blocks).
+    msgs = [b"", b"native self check", b"x" * 300]
+    ra = b"".join(
+        bytes([i]) * 32 + bytes([0x80 | i]) * 32
+        for i in range(len(msgs))
+    )
+    got_ks = _bulk_challenges_raw(lib, ra, msgs)
+    for i, msg in enumerate(msgs):
+        h = hashlib.sha512()
+        h.update(bytes([i]) * 32)
+        h.update(bytes([0x80 | i]) * 32)
+        h.update(msg)
+        if got_ks[i] != scalar.from_hash(h):
+            raise RuntimeError("native bulk_challenges disagreement")
 
 
 def _decompress_batch_raw(lib, encodings):
@@ -258,6 +279,36 @@ def stage_scalars(s_blob: bytes, k_blob: bytes, z_blob: bytes, n: int,
         for g in range(m)
     ]
     return b_acc, a_accs
+
+
+def _bulk_challenges_raw(lib, ra_blob: bytes, msgs) -> "list[int]":
+    n = len(msgs)
+    offs = (ctypes.c_uint64 * (n + 1))()
+    total = 0
+    for i, m in enumerate(msgs):
+        offs[i] = total
+        total += len(m)
+    offs[n] = total
+    msg_blob = b"".join(msgs)
+    out = ctypes.create_string_buffer(32 * n)
+    lib.bulk_challenges(ra_blob, msg_blob,
+                        ctypes.cast(offs, ctypes.c_char_p), n, out)
+    raw = out.raw
+    return [int.from_bytes(raw[32 * i: 32 * i + 32], "little")
+            for i in range(n)]
+
+
+def bulk_challenges(ra_blob: bytes, msgs):
+    """Challenge scalars k_i = SHA-512(R_i ‖ A_i ‖ msg_i) mod ℓ for a
+    whole stream in ONE native call (the per-item hash the reference
+    computes at queue time, src/batch.rs:85-91).  `ra_blob` is n
+    concatenated 64-byte R‖A rows; `msgs` the matching message list.
+    Returns list[int], or NotImplemented when the native library is
+    unavailable (caller falls back to hashlib per item)."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    return _bulk_challenges_raw(lib, ra_blob, msgs)
 
 
 def point_from_raw(row) -> "object":

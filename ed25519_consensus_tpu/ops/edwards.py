@@ -244,16 +244,35 @@ def basepoint_shift128() -> Point:
     return _BASEPOINT_SHIFT128
 
 
-def multiscalar_mul(scalars, points) -> Point:
+def multiscalar_mul(scalars, points, chunk: int = 1024) -> Point:
     """Σ [c_i]P_i — host MSM (dalek `VartimeMultiscalarMul`, reference
     src/batch.rs:207-210).  Straus with shared doublings and per-point 4-bit
-    tables; exact, variable-time (verification uses no secrets)."""
+    tables; exact, variable-time (verification uses no secrets).
+
+    Memory is bounded by `chunk`: terms are processed in chunks of at most
+    that many points, so at most 16·chunk table entries are ever live —
+    this is the advertised no-native fallback and must survive 100k+-term
+    batches.  The only cost of chunking is repeating the shared window
+    doublings per chunk (~128 doubles each — noise next to the per-term
+    table builds), and the chunk partials add up exactly (the group is
+    commutative/associative)."""
     scalars = list(scalars)
     points = list(points)
     if len(scalars) != len(points):
         raise ValueError("scalar/point length mismatch")
     if not scalars:
         return identity()
+    if chunk < 1:
+        raise ValueError("chunk must be positive")
+    if len(scalars) > chunk:
+        acc = identity()
+        for lo in range(0, len(scalars), chunk):
+            acc = acc.add(
+                multiscalar_mul(
+                    scalars[lo:lo + chunk], points[lo:lo + chunk], chunk
+                )
+            )
+        return acc
     tables = []
     for Pt in points:
         row = [identity(), Pt]

@@ -63,6 +63,39 @@ from .limbs import NLIMBS
 # dispatch + fetch critical section.
 DEVICE_CALL_LOCK = threading.RLock()
 
+_cache_configured = [False]
+
+
+def ensure_compile_cache():
+    """Enable jax's persistent compilation cache on ACCELERATOR backends
+    (kernel compiles through the remote-compile tunnel run 1-6 MINUTES
+    per lane-count; the cache makes the compile part once-ever instead of
+    once-per-process).  Env vars alone do not activate it in this jax
+    build — `jax.config.update` is required — so every kernel builder
+    calls this first.  The CPU backend is deliberately EXCLUDED: cache
+    bookkeeping on the huge interpret-mode executables turns a ~70 s
+    compile into 20+ minutes (measured), and CPU compiles are cheap
+    anyway.  Opt out with ED25519_TPU_JAX_CACHE_DIR=''."""
+    if _cache_configured[0]:
+        return
+    _cache_configured[0] = True
+    import os
+
+    d = os.environ.get("ED25519_TPU_JAX_CACHE_DIR")
+    if d is None:
+        d = os.path.expanduser("~/.cache/ed25519_tpu_jax")
+    if not d:
+        return
+    try:
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            return
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never fail dispatch over it
+
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
 
 WINDOW_BITS = limbs.WINDOW_BITS
@@ -114,6 +147,7 @@ def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
     Input: digits (nwin, N) int8, SIGNED digits in [-8, 8], MSB-first;
            points (4, NLIMBS, N) int16.
     Output: (4, NLIMBS, nwin) int32 — the per-window sums S_w."""
+    ensure_compile_cache()
     import jax
     import jax.numpy as jnp
 

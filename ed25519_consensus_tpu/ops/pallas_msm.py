@@ -29,9 +29,12 @@ per-window sums), hand-blocked for the VPU:
   registers.
 
 The final Horner combine over windows stays exact host bigint math
-(ops/msm.py).  Parity with the exact host arithmetic is pinned by
-tests/test_pallas_msm.py (interpreter mode on the CPU backend) and by the
-device-parity suite when a TPU is attached."""
+(ops/msm.py).  Parity with the exact host arithmetic is pinned three ways:
+tests/test_pallas_msm.py runs the operand packing checks plus one
+multi-block interpret-mode kernel case (with a shrunken tile — full-size
+interpret on the CPU backend is minutes per case), and
+tools/check_pallas_parity.py runs the real Mosaic kernel on TPU hardware
+over the adversarial fixture classes."""
 
 import functools
 
@@ -129,13 +132,19 @@ def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
                             nwin: int = NWINDOWS,
                             interpret: bool = False,
                             tile=(SUBLANES, LANES),
-                            tbl_dtype="int16"):
+                            tbl_dtype="int16",
+                            win_chunk: int = 1):
     """digits (B, nwin, nb, S, L) int8 (signed, d ∈ [-8, 8]),
     points (B, 4, NLIMBS, nb, S, L) int16
     → per-block partial window sums (B, nb, nwin, 4, NLIMBS, fS, L) int16.
 
     `tile` is the (sublane, lane) block shape — (32, 128) on hardware;
-    interpreter-mode tests shrink it so tiny cases stay fast."""
+    interpreter-mode tests shrink it so tiny cases stay fast.
+    `win_chunk` processes that many windows per grid step (must divide
+    nwin) to amortize per-step fixed costs."""
+    from .msm import ensure_compile_cache
+
+    ensure_compile_cache()
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -144,6 +153,8 @@ def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
     S, Ln = tile
     fS = min(FOLD_SUBLANES, S)
     tdt = jnp.int16 if tbl_dtype == "int16" else jnp.int32
+    W = win_chunk
+    assert nwin % W == 0
 
     def kernel(dig_ref, pts_ref, out_ref, tbl_ref):
         w = pl.program_id(2)
@@ -183,52 +194,54 @@ def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
 
             jax.lax.fori_loop(2, 9, table_body, 0)
 
-        # --- this window: select + in-block lane fold (all indices
-        # static — the window is a grid axis, so the hot path has no
-        # dynamic VMEM addressing at all) ---------------------------------
-        d = dig_ref[0, 0, 0].astype(jnp.int32)  # (S, Ln)
-        mag = jnp.abs(d)
-        sel = None
-        for k in range(9):
-            mask = (mag == k).astype(jnp.int32)
-            entry = tuple(
-                [tbl_ref[k, c, l].astype(jnp.int32)
-                 for l in range(NLIMBS)]
-                for c in range(4)
+        # --- this step's windows: select + in-block lane fold (all
+        # indices static — windows are unrolled within the step and the
+        # window chunk is a grid axis, so the hot path has no dynamic
+        # VMEM addressing at all) -----------------------------------------
+        for wi in range(W):
+            d = dig_ref[0, wi, 0].astype(jnp.int32)  # (S, Ln)
+            mag = jnp.abs(d)
+            sel = None
+            for k in range(9):
+                mask = (mag == k).astype(jnp.int32)
+                entry = tuple(
+                    [tbl_ref[k, c, l].astype(jnp.int32)
+                     for l in range(NLIMBS)]
+                    for c in range(4)
+                )
+                contrib = tuple(
+                    [mask * limb for limb in coord] for coord in entry
+                )
+                sel = contrib if sel is None else tuple(
+                    [x + y for x, y in zip(sc, cc)]
+                    for sc, cc in zip(sel, contrib)
+                )
+            # negative digits: negate X and T (free in balanced limbs)
+            sgn = jnp.where(d < 0, jnp.int32(-1), jnp.int32(1))
+            sel = (
+                [sgn * x for x in sel[0]],
+                sel[1],
+                sel[2],
+                [sgn * x for x in sel[3]],
             )
-            contrib = tuple(
-                [mask * limb for limb in coord] for coord in entry
-            )
-            sel = contrib if sel is None else tuple(
-                [x + y for x, y in zip(sc, cc)]
-                for sc, cc in zip(sel, contrib)
-            )
-        # negative digits: negate X and T (free in balanced limbs)
-        sgn = jnp.where(d < 0, jnp.int32(-1), jnp.int32(1))
-        sel = (
-            [sgn * x for x in sel[0]],
-            sel[1],
-            sel[2],
-            [sgn * x for x in sel[3]],
-        )
-        # fold the sublane rows down by halving point-adds
-        s = S
-        while s > fS:
-            half = s // 2
-            lo = tuple([x[:half] for x in coord] for coord in sel)
-            hi = tuple([x[half:] for x in coord] for coord in sel)
-            sel = _padd(lo, hi)
-            s = half
-        for c in range(4):
-            for l in range(NLIMBS):
-                out_ref[0, 0, 0, c, l] = sel[c][l].astype(jnp.int16)
+            # fold the sublane rows down by halving point-adds
+            s = S
+            while s > fS:
+                half = s // 2
+                lo = tuple([x[:half] for x in coord] for coord in sel)
+                hi = tuple([x[half:] for x in coord] for coord in sel)
+                sel = _padd(lo, hi)
+                s = half
+            for c in range(4):
+                for l in range(NLIMBS):
+                    out_ref[0, 0, wi, c, l] = sel[c][l].astype(jnp.int16)
 
     return pl.pallas_call(
         kernel,
-        grid=(n_batches, n_blocks, nwin),
+        grid=(n_batches, n_blocks, nwin // W),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, 1, S, Ln), lambda b, i, w: (b, w, i, 0, 0)
+                (1, W, 1, S, Ln), lambda b, i, w: (b, w, i, 0, 0)
             ),
             pl.BlockSpec(
                 (1, 4, NLIMBS, 1, S, Ln),
@@ -236,7 +249,7 @@ def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, 1, 4, NLIMBS, fS, Ln),
+            (1, 1, W, 4, NLIMBS, fS, Ln),
             lambda b, i, w: (b, i, w, 0, 0, 0, 0),
         ),
         out_shape=jax.ShapeDtypeStruct(
@@ -253,7 +266,7 @@ def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
 @functools.lru_cache(maxsize=None)
 def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
                        interpret: bool = False, tile=(SUBLANES, LANES),
-                       tbl_dtype="int16"):
+                       tbl_dtype="int16", win_chunk: int = 1):
     """ONE jitted function for the whole device step: Pallas partial-sum
     kernel + XLA fold of the per-block partials, so a multi-batch
     verification is a single tunnel call.
@@ -270,7 +283,8 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     n_blocks = n_lanes // group
     kernel = _compiled_pallas_kernel(n_batches, n_blocks, nwin,
                                      interpret=interpret, tile=tile,
-                                     tbl_dtype=tbl_dtype)
+                                     tbl_dtype=tbl_dtype,
+                                     win_chunk=win_chunk)
     fS = min(FOLD_SUBLANES, S)
 
     def pipeline(digits, points):
@@ -312,15 +326,43 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     return jax.jit(pipeline)
 
 
+def _auto_win_chunk(nwin: int) -> int:
+    """Windows per grid step: measured on v5e (tools/kernel_lab.py,
+    BASELINE.md), each grid step carries ~320 µs fixed cost next to
+    ~470 µs per window of work, so batching 11 windows per step is ~1.6×
+    end-to-end.  Overridable via ED25519_TPU_WIN_CHUNK."""
+    import os
+    import warnings
+
+    env = os.environ.get("ED25519_TPU_WIN_CHUNK")
+    if env:
+        try:
+            w = int(env)
+        except ValueError:
+            w = 0
+        if w > 0 and nwin % w == 0:
+            return w
+        warnings.warn(
+            f"ED25519_TPU_WIN_CHUNK={env!r} ignored: must be a positive "
+            f"divisor of {nwin}", stacklevel=2)
+    for w in (11, 3):
+        if nwin % w == 0:
+            return w
+    return 1
+
+
 def pallas_window_sums_many(digits, points, interpret: bool = False,
-                            tile=(SUBLANES, LANES)):
+                            tile=(SUBLANES, LANES), tbl_dtype="int16",
+                            win_chunk: int | None = None):
     """Batched dispatch: digits (B, nwin, N) int8, points (B, 4, NLIMBS, N)
     int16 numpy arrays → (B, 4, NLIMBS, nwin) device array, one device
     call."""
     B, nwin, N = digits.shape
-    return _compiled_pipeline(B, N, nwin, interpret=interpret, tile=tile)(
-        digits, points
-    )
+    if win_chunk is None:
+        win_chunk = _auto_win_chunk(nwin)
+    return _compiled_pipeline(B, N, nwin, interpret=interpret, tile=tile,
+                              tbl_dtype=tbl_dtype,
+                              win_chunk=win_chunk)(digits, points)
 
 
 def pallas_window_sums(digits, points, interpret: bool = False,

@@ -34,6 +34,7 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
     Input shapes (global): digits (nwin, N), points (4, NLIMBS, N) with
     N = n_devices * lanes_per_device; output: replicated
     (4, NLIMBS, nwin) window sums."""
+    msm_lib.ensure_compile_cache()
     import jax
     from jax.sharding import PartitionSpec as P
 

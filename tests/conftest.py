@@ -18,3 +18,21 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _shutdown_device_lane_at_session_end():
+    """Join the device-lane worker BEFORE interpreter teardown: a lane
+    thread that has entered the accelerator runtime aborts the process if
+    it is still alive when the runtime's own atexit teardown runs (the
+    same reason bench.py ends with os._exit)."""
+    yield
+    from ed25519_consensus_tpu import batch
+
+    inst = batch._DeviceLane._instance
+    if inst is not None and inst.healthy():
+        inst.shutdown()
+    batch._DeviceLane._instance = None

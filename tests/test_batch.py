@@ -143,3 +143,104 @@ def test_verify_many_edge_shapes():
     bad.queue((sk.verification_key_bytes(), sk.sign(b"x"), b"y"))
     assert batch.verify_many([empty, one, bad], rng=rng) == \
         [True, True, False]
+
+
+def test_queue_bulk_matches_queue():
+    """queue_bulk (native bulk challenge hashing) must build EXACTLY the
+    same coalescing map as per-item queue — same keys, same challenge
+    scalars, same order — and verify identically."""
+    entries = []
+    for i in range(40):
+        sk = SigningKey.new(rng)
+        msg = b"bulk-%d" % i if i % 3 else b""  # empty msgs too
+        entries.append((sk.verification_key_bytes(), sk.sign(msg), msg))
+    # repeat a key to exercise coalescing in both paths
+    vkb0, sig0, msg0 = entries[0]
+    entries.append((vkb0, sig0, msg0))
+    a = batch.Verifier()
+    for e in entries:
+        a.queue(e)
+    b = batch.Verifier()
+    b.queue_bulk(entries)
+    assert b.batch_size == a.batch_size
+    assert list(b.signatures.keys()) == list(a.signatures.keys())
+    for k in a.signatures:
+        assert [int(x[0]) for x in a.signatures[k]] == \
+               [int(x[0]) for x in b.signatures[k]]
+    b.verify(rng=rng)
+
+
+def test_queue_bulk_fallback_without_native(monkeypatch):
+    """Without the native library queue_bulk must fall back to the exact
+    per-item path."""
+    from ed25519_consensus_tpu import native
+
+    monkeypatch.setattr(native, "bulk_challenges",
+                        lambda ra, msgs: NotImplemented)
+    entries = []
+    for i in range(6):
+        sk = SigningKey.new(rng)
+        msg = b"fallback-%d" % i
+        entries.append((sk.verification_key_bytes(), sk.sign(msg), msg))
+    bv = batch.Verifier()
+    bv.queue_bulk(entries)
+    assert bv.batch_size == 6
+    bv.verify(rng=rng)
+
+
+def test_verify_single_many_per_signature_verdicts():
+    """verify_single_many: per-signature ZIP215 verdicts at batch speed —
+    valid, tampered, malformed-wire, and non-canonical-s entries mixed."""
+    from ed25519_consensus_tpu import Signature
+    from ed25519_consensus_tpu.ops.scalar import L
+
+    entries, want = [], []
+    for i in range(30):
+        sk = SigningKey.new(rng)
+        msg = b"vsm-%d" % i
+        sig = sk.sign(msg)
+        if i % 7 == 3:
+            sig = sk.sign(b"tampered")  # wrong msg: invalid
+            want.append(False)
+        elif i == 10:
+            sig = Signature(sig.R_bytes, int(L).to_bytes(32, "little"))
+            want.append(False)  # s >= l rejected
+        else:
+            want.append(True)
+        entries.append((sk.verification_key_bytes(), sig, msg))
+    # malformed wire bytes: wrong-length key
+    entries.append((b"\x01" * 31, entries[0][1], b"x"))
+    want.append(False)
+    # raw-bytes inputs must work too
+    vkb, sig, msg = entries[0]
+    entries.append((vkb.to_bytes(), bytes(sig), msg))
+    want.append(True)
+    got = batch.verify_single_many(entries, rng=rng)
+    assert got == want
+    # every verdict must agree with the per-call reference path
+    from ed25519_consensus_tpu import (
+        InvalidSliceLength, MalformedPublicKey, VerificationKey)
+    for (vkb, sig, msg), w in zip(entries[:31], want[:31]):
+        if not isinstance(sig, Signature):
+            sig = Signature.from_bytes(sig)
+        try:
+            VerificationKey.from_bytes(vkb).verify(sig, msg)
+            single = True
+        except (InvalidSignature, MalformedPublicKey, InvalidSliceLength):
+            single = False
+        assert single == w
+
+
+def test_verify_single_many_repeated_keys():
+    """Entries sharing a key must each get their own verdict (the per-key
+    regroup hands challenges back in entry order)."""
+    sk = SigningKey.new(rng)
+    vkb = sk.verification_key_bytes()
+    entries = []
+    want = []
+    for i in range(9):
+        msg = b"rep-%d" % i
+        sig = sk.sign(msg if i != 4 else b"evil")
+        entries.append((vkb, sig, msg))
+        want.append(i != 4)
+    assert batch.verify_single_many(entries, rng=rng) == want

@@ -140,3 +140,24 @@ def test_small_order_matrix_device_parity():
             bv.queue((A_bytes, Signature(R_bytes, s_bytes), b"Zcash"))
     assert bv.batch_size == 196
     bv.verify(rng=rng, backend="device")
+
+
+def test_device_msm_matches_host_large_n_multiblock():
+    """MSM-level parity on n ≥ 2·GROUP_LANES — drives the multi-block scan
+    path (block accumulation + cross-block fold) that the small-n cases
+    miss, with torsion points and zero/one/max and full-width (split-term)
+    scalars mixed across block boundaries."""
+    from ed25519_consensus_tpu.ops import msm
+
+    tors = edwards.eight_torsion()
+    n = 2 * msm.GROUP_LANES + 44  # 300 terms -> 3 lane blocks with padding
+    pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, L))
+           for _ in range(n - 8)] + tors
+    sc = [rng.randrange(1 << 128) for _ in range(n)]
+    # edge scalars placed to straddle block boundaries
+    sc[0] = 0
+    sc[1] = 1
+    sc[msm.GROUP_LANES - 1] = (1 << 128) - 1
+    sc[msm.GROUP_LANES] = L - 1          # full-width: exercises the
+    sc[2 * msm.GROUP_LANES] = (1 << 253) - 1  # split-term path
+    assert msm.device_msm(sc, pts) == edwards.multiscalar_mul(sc, pts)

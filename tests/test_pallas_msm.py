@@ -64,3 +64,40 @@ def test_point_packing_int16_from_raw():
     assert packed.dtype == np.int16
     want = limbs.pack_point_batch(pts)
     assert np.array_equal(packed.astype(np.int32), want)
+
+
+def test_multiblock_interpret_kernel_parity():
+    """Run the ACTUAL Pallas kernel in interpret mode across MULTIPLE grid
+    blocks and pin it against the exact host MSM — covers the in-kernel
+    table build, signed-digit select, cross-block fold, and
+    block-boundary/identity padding.
+
+    Infrastructure note: interpret=True lowers to plain XLA ops, but
+    compiling the ~80k-op unrolled body on this repo's 1-core build host
+    takes 10-25 minutes on the TRUE cpu backend (measured; it is compile
+    time, not a hang).  The case therefore runs in a clean subprocess on
+    whatever accelerator is attached (remote compile ~1-2 min) and SKIPS
+    on cpu-only hosts — where Mosaic coverage comes from the committed
+    hardware gate artifact (tools/check_pallas_parity.py,
+    bench_artifacts/pallas_parity_r2.txt)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "interp_parity_case.py")],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    out = proc.stdout + proc.stderr
+    assert "INTERP_PARITY" in out, out[-2000:]
+    if "SKIP" in out:
+        import pytest
+
+        pytest.skip("no accelerator attached: interpret compile is "
+                    "10-25 min on the true cpu backend; Mosaic parity is "
+                    "covered by tools/check_pallas_parity.py")
+    assert "MATCH" in out and "MISMATCH" not in out, out[-2000:]

@@ -81,7 +81,7 @@ def test_device_error_falls_back_to_host(monkeypatch):
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
     vs = make_verifiers(6, bad={2})
-    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     assert verdicts == expected(6, bad={2})
     stats = batch.last_run_stats
     assert stats["device_batches"] == 0
@@ -113,7 +113,7 @@ def test_error_chunk_benches_device_for_the_call(monkeypatch):
 
     monkeypatch.setattr(batch.StagedBatch, "host_msm", slow_host_msm)
     vs = make_verifiers(10, bad={3})
-    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     assert verdicts == expected(10, bad={3})
     # exactly the probe reached the device; everything else stayed host
     assert len(calls) == 1
@@ -137,7 +137,8 @@ def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     vs = make_verifiers(5, bad={0})
     t0 = time.monotonic()
     try:
-        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False)
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                    merge="never")
     finally:
         release.set()  # let the abandoned worker die promptly
     assert verdicts == expected(5, bad={0})
@@ -161,7 +162,7 @@ def test_cooldown_skips_device_entirely(monkeypatch):
 
     monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
     vs = make_verifiers(4, bad={3})
-    assert batch.verify_many(vs, rng=rng) == expected(4, bad={3})
+    assert batch.verify_many(vs, rng=rng, merge="never") == expected(4, bad={3})
     assert batch.last_run_stats["host_batches"] == 4
 
 
@@ -178,7 +179,7 @@ def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
     monkeypatch.setattr(msm, "dispatch_window_sums_many", slow)
     vs = make_verifiers(10, bad={1})
     t0 = time.monotonic()
-    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     assert verdicts == expected(10, bad={1})
     stats = dict(batch.last_run_stats)
     assert not stats["device_sick"]
@@ -192,7 +193,7 @@ def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
 
     monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
     vs2 = make_verifiers(4)
-    assert batch.verify_many(vs2, rng=rng) == expected(4)
+    assert batch.verify_many(vs2, rng=rng, merge="never") == expected(4)
 
 
 def test_host_overtake_discards_inflight_chunk(monkeypatch):
@@ -216,7 +217,7 @@ def test_host_overtake_discards_inflight_chunk(monkeypatch):
     monkeypatch.setattr(batch._DeviceLane, "discard", spy_discard)
     vs = make_verifiers(4, bad={2})
     try:
-        verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     finally:
         release.set()
     assert verdicts == expected(4, bad={2})
@@ -249,7 +250,7 @@ def test_competitive_device_wins_more_than_probe(monkeypatch):
 
     monkeypatch.setattr(batch.StagedBatch, "host_msm", slow_host_msm)
     vs = make_verifiers(12, bad={5})
-    verdicts = batch.verify_many(vs, rng=rng, chunk=2)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     assert verdicts == expected(12, bad={5})
     stats = batch.last_run_stats
     assert stats["device_batches"] > 2, (
@@ -262,9 +263,77 @@ def test_verify_many_all_host_when_no_device_needed():
     """Sanity: the scheduler path with the real (CPU backend) kernel ends
     with every batch decided exactly once."""
     vs = make_verifiers(9, bad={4, 7})
-    verdicts = batch.verify_many(vs, rng=rng, chunk=3)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=3, merge="never")
     assert verdicts == expected(9, bad={4, 7})
     stats = batch.last_run_stats
     assert stats["host_batches"] + stats["device_batches"] >= 9
     assert stats["batches"] == 9
     assert stats["sigs"] == 27
+
+
+def test_merge_union_all_valid_stream():
+    """A stream of small all-valid batches union-merges: one (or few) big
+    MSMs decide every member True."""
+    vs = make_verifiers(24, sigs_per_batch=4)
+    verdicts = batch.verify_many(vs, rng=rng, merge="always")
+    assert verdicts == expected(24)
+    assert batch.last_run_stats["merged_unions"] >= 1
+    assert batch.last_run_stats["batches"] == 24
+
+
+def test_merge_union_bisects_bad_batches():
+    """Bad batches inside a merged stream are pinpointed by bisection; all
+    verdicts match the per-batch ground truth."""
+    bad = {3, 17}
+    vs = make_verifiers(20, sigs_per_batch=4, bad=bad)
+    verdicts = batch.verify_many(vs, rng=rng, merge="always")
+    assert verdicts == expected(20, bad=bad)
+
+
+def test_merge_union_handles_malformed_staging():
+    """A batch whose staging rejects (s ≥ ℓ) poisons its union; bisection
+    still isolates it and the rest verify True."""
+    from ed25519_consensus_tpu import Signature
+    from ed25519_consensus_tpu.ops.scalar import L
+
+    vs = make_verifiers(8, sigs_per_batch=3)
+    sk = SigningKey.new(rng)
+    msg = b"malformed-s"
+    sig = sk.sign(msg)
+    bad_sig = Signature(sig.R_bytes, int(L).to_bytes(32, "little"))
+    vs[5].queue((sk.verification_key_bytes(), bad_sig, msg))
+    verdicts = batch.verify_many(vs, rng=rng, merge="always")
+    assert verdicts == expected(8, bad={5})
+
+
+def test_merge_groups_respect_target():
+    """Greedy grouping: unions close on crossing the target and every
+    index appears exactly once, in order."""
+    vs = make_verifiers(10, sigs_per_batch=2)
+    old = batch._MERGE_TARGET_SIGS
+    batch._MERGE_TARGET_SIGS = 6
+    try:
+        groups = batch._merge_groups(vs)
+    finally:
+        batch._MERGE_TARGET_SIGS = old
+    assert [i for g in groups for i in g] == list(range(10))
+    assert all(sum(vs[i].batch_size for i in g) >= 6 for g in groups[:-1])
+
+
+def test_merge_does_not_mutate_members():
+    """Union-merging must not alias the member verifiers' signature
+    lists."""
+    vs = make_verifiers(4, sigs_per_batch=2)
+    before = {id(lst) for v in vs for lst in v.signatures.values()}
+    sizes = [v.batch_size for v in vs]
+    u = batch.merge_verifiers(vs)
+    assert u.batch_size == sum(sizes)
+    for v in vs:
+        assert all(id(lst) not in {id(l2) for l2 in u.signatures.values()}
+                   or len(lst) == 0
+                   for lst in v.signatures.values())
+    # mutating the union must not leak into members
+    for lst in u.signatures.values():
+        lst.clear()
+    assert [v.batch_size for v in vs] == sizes
+    assert all(len(lst) for v in vs for lst in v.signatures.values())

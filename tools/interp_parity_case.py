@@ -1,0 +1,67 @@
+"""Multi-block interpret-mode Pallas MSM parity case (subprocess helper).
+
+Run WITHOUT forcing the cpu backend: interpret=True lowers the kernel to
+plain XLA ops, so this pins the operand packing, grid/block indexing,
+in-kernel table build, signed-digit select, and cross-block fold against
+the exact host MSM on whatever backend is attached.  On an accelerator
+the giant unrolled graph compiles remotely in ~1-2 min; on this repo's
+1-core build host a true-CPU compile of the same graph takes 10-25 min
+(measured — XLA CPU compile, not a hang), which is why the pytest
+wrapper (tests/test_pallas_msm.py) runs it via subprocess on the
+accelerator and skips on cpu-only hosts, deferring Mosaic coverage to
+tools/check_pallas_parity.py.
+
+Prints one line: `INTERP_PARITY <backend> MATCH|MISMATCH`.
+"""
+
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu.ops import edwards, msm, pallas_msm  # noqa: E402
+
+
+def main():
+    import jax
+
+    backend = jax.devices()[0].platform
+    if backend == "cpu":
+        print("INTERP_PARITY cpu SKIP")  # compile is 10-25 min here
+        sys.stdout.flush()
+        os._exit(0)
+    rng = random.Random(0x1417)
+    tile = (1, 128)
+    group = tile[0] * tile[1]
+    n = group + 5  # 2 grid blocks + identity padding in the last
+    tors = edwards.eight_torsion()
+    pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, 10_000))
+           for _ in range(n - 4)] + tors[1:5]
+    sc = [rng.randrange(16) for _ in range(n)]
+    sc[0] = 0          # identity contribution
+    sc[1] = 1
+    sc[2] = 15         # signed recode carries across the plane boundary
+    sc[group - 1] = 15  # ... and at the block boundary
+    sc[group] = 8       # digit at the signed-table edge
+    digits, packed = msm.pack_msm_operands(
+        sc, pts, n_lanes=pallas_msm.pad_lanes(n, group)
+    )
+    digits = digits[-2:]  # scalars < 16: higher MSB-first planes all zero
+    out = np.asarray(
+        pallas_msm.pallas_window_sums_many(
+            digits[None], packed[None], interpret=True, tile=tile
+        )
+    )
+    got = msm.combine_window_sums(out)
+    want = edwards.multiscalar_mul(sc, pts)
+    print(f"INTERP_PARITY {backend} "
+          f"{'MATCH' if got == want else 'MISMATCH'}")
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Pallas MSM kernel experiments on the real TPU.
+
+Round-1 finding (docs/DESIGN.md): the kernel runs ~9× above its tile-op
+lower bound (~1.6 ms per grid step vs ~40 µs issued); prime suspect is the
+720-per-step int16→int32 table-read relayouts.  This lab measures kernel
+variants honestly on the tunneled chip (np.asarray round-trips only;
+slopes between iteration counts cancel the RTT).
+
+Usage: python tools/kernel_lab.py [--exp baseline|i32|sel16|multiwin|all]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/ed25519_tpu_jax"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import numpy as np  # noqa: E402
+
+
+def build_operands(n_lanes, B=1, seed=7):
+    """Random-ish valid operands: basepoint multiples + random digits."""
+    import random
+
+    from ed25519_consensus_tpu.ops import edwards, msm
+
+    rng = random.Random(seed)
+    n = n_lanes
+    pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, 2**252))
+           for _ in range(min(n, 64))]
+    pts = [pts[i % len(pts)] for i in range(n)]
+    sc = [rng.randrange(2**128) for _ in range(n)]
+    digits, packed = msm.pack_msm_operands(sc, pts, n_lanes=n_lanes)
+    if B > 1:
+        digits = np.broadcast_to(digits, (B,) + digits.shape).copy()
+        packed = np.broadcast_to(packed, (B,) + packed.shape).copy()
+    else:
+        digits, packed = digits[None], packed[None]
+    return sc, pts, digits, packed
+
+
+def timed_calls(fn, digits, pts, reps=7):
+    """Median wall time of fn(digits, pts) with a full D2H fetch."""
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(digits, pts))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def check_parity(out, sc, pts, label):
+    from ed25519_consensus_tpu.ops import edwards, msm
+
+    got = msm.combine_window_sums(np.asarray(out)[:1])
+    want = edwards.multiscalar_mul(sc, pts)
+    ok = got == want
+    print(f"#   parity[{label}]: {'OK' if ok else 'MISMATCH'}", flush=True)
+    return ok
+
+
+def exp_baseline():
+    """Current kernel: B-scaling over blocks (4096/8192/16384 lanes) and
+    batch stacking (B=1 vs 4) to split per-call overhead from kernel
+    time."""
+    from ed25519_consensus_tpu.ops import pallas_msm
+
+    print("# exp baseline: current int16-table kernel", flush=True)
+    rows = []
+    for n_lanes in (4096, 8192, 16384):
+        sc, pts, digits, packed = build_operands(n_lanes)
+        fn = lambda d, p: pallas_msm.pallas_window_sums_many(d, p)  # noqa
+        t0 = time.perf_counter()
+        out = fn(digits, packed)
+        np.asarray(out)
+        print(f"#   n={n_lanes}: first call (compile) "
+              f"{time.perf_counter()-t0:.1f}s", flush=True)
+        if n_lanes == 4096:
+            check_parity(out, sc, pts, f"n={n_lanes}")
+        t = timed_calls(fn, digits, packed)
+        rows.append((n_lanes, 1, t))
+        print(f"#   n={n_lanes} B=1: {t*1000:.1f} ms/call", flush=True)
+    # slope: ms per extra 4096-lane block (33 grid steps)
+    (n1, _, t1), (n2, _, t2) = rows[0], rows[2]
+    per_block = (t2 - t1) / ((n2 - n1) / 4096)
+    print(f"#   slope: {per_block*1000:.1f} ms per 4096-term block "
+          f"({per_block/33*1e6:.0f} us per grid step)", flush=True)
+    # batch stacking
+    sc, pts, digits, packed = build_operands(4096, B=4)
+    t = timed_calls(
+        lambda d, p: pallas_msm.pallas_window_sums_many(d, p),
+        digits, packed)
+    print(f"#   n=4096 B=4: {t*1000:.1f} ms/call "
+          f"({t*1000/4:.1f} ms/batch)", flush=True)
+
+
+def exp_variant(name, **kw):
+    """Compile + time a kernel variant at two sizes; report the slope."""
+    from ed25519_consensus_tpu.ops import pallas_msm
+
+    print(f"# exp {name}: {kw}", flush=True)
+    rows = []
+    for n_lanes in (4096, 16384):
+        sc, pts, digits, packed = build_operands(n_lanes)
+        fn = lambda d, p: pallas_msm.pallas_window_sums_many(d, p, **kw)  # noqa
+        try:
+            t0 = time.perf_counter()
+            out = fn(digits, packed)
+            np.asarray(out)
+            print(f"#   n={n_lanes}: first call (compile) "
+                  f"{time.perf_counter()-t0:.1f}s", flush=True)
+        except Exception as e:
+            print(f"#   n={n_lanes}: COMPILE/RUN FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            return
+        if n_lanes == 4096 and not check_parity(out, sc, pts, name):
+            return
+        t = timed_calls(fn, digits, packed)
+        rows.append((n_lanes, t))
+        print(f"#   n={n_lanes}: {t*1000:.1f} ms/call", flush=True)
+    (n1, t1), (n2, t2) = rows
+    per_block = (t2 - t1) / ((n2 - n1) / 4096)
+    print(f"#   slope: {per_block*1000:.1f} ms per 4096-term block",
+          flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--exp", default="baseline")
+    args = ap.parse_args()
+    import jax
+
+    print(f"# devices: {jax.devices()}", flush=True)
+    if args.exp in ("baseline", "all"):
+        exp_baseline()
+    if args.exp in ("i32", "all"):
+        exp_variant("int32-table-G2048", tile=(16, 128), tbl_dtype="int32")
+    if args.exp in ("i32big",):
+        exp_variant("int32-table-G4096", tbl_dtype="int32")
+    if args.exp in ("s8", "all8"):
+        exp_variant("tile8-int16", tile=(8, 128))
+    if args.exp in ("s8i32", "all8"):
+        exp_variant("tile8-int32", tile=(8, 128), tbl_dtype="int32")
+    if args.exp in ("s16", "all8"):
+        exp_variant("tile16-int16", tile=(16, 128))
+    if args.exp in ("w3", "allw"):
+        exp_variant("winchunk3", win_chunk=3)
+    if args.exp in ("w11", "allw"):
+        exp_variant("winchunk11", win_chunk=11)
+    if args.exp in ("w11i32", "allw"):
+        exp_variant("winchunk11-i32-G2048", tile=(16, 128),
+                    tbl_dtype="int32", win_chunk=11)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

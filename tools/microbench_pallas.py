@@ -1,0 +1,123 @@
+"""Micro-probes for per-op cost inside a Pallas TPU kernel on this chip.
+
+The MSM kernel runs ~17× above its ALU estimate and well under VMEM
+bandwidth; this isolates WHERE per-op time goes: chained elementwise ops,
+the _fmul schoolbook, a full _padd, and the select pattern — each as a
+standalone kernel, timed by slope between two chain lengths (cancels call
+overhead/RTT).
+"""
+
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/ed25519_tpu_jax"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import numpy as np  # noqa: E402
+
+
+def timed(fn, *args, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def probe_chain(op: str, tile=(32, 128), n_steps=(64, 512)):
+    """Kernel = chain of `op` on a tile; report ns/op from the slope."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    S, L = tile
+
+    def make(n):
+        def kernel(x_ref, o_ref):
+            a = x_ref[...]
+            b = a + 1
+            for i in range(n):
+                if op == "add":
+                    a, b = b, a + b
+                elif op == "mul":
+                    a, b = b, a * b
+                elif op == "shift":
+                    a, b = b, (a + 4096) >> 13
+                elif op == "madd":
+                    a, b = b, a * 3 + b
+            o_ref[...] = b
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((S, L), jnp.int32),
+        )
+
+    x = np.arange(S * L, dtype=np.int32).reshape(S, L) % 97
+    fns = {}
+    for n in n_steps:
+        f = jax.jit(make(n))
+        np.asarray(f(x))  # compile
+        fns[n] = f
+    t1, t2 = timed(fns[n_steps[0]], x), timed(fns[n_steps[1]], x)
+    per_op = (t2 - t1) / (n_steps[1] - n_steps[0])
+    print(f"#   chain[{op}] tile={tile}: {per_op*1e9:.0f} ns/op "
+          f"(t{n_steps[0]}={t1*1e3:.2f}ms t{n_steps[1]}={t2*1e3:.2f}ms)",
+          flush=True)
+
+
+def probe_fmul(tile=(32, 128), n_steps=(1, 8)):
+    """Chain of full _fmul schoolbook products (field muls)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from ed25519_consensus_tpu.ops.pallas_msm import _fmul, NLIMBS
+
+    S, L = tile
+
+    def make(n):
+        def kernel(x_ref, o_ref):
+            a = [x_ref[i] for i in range(NLIMBS)]
+            b = [x_ref[i] + 1 for i in range(NLIMBS)]
+            for _ in range(n):
+                a, b = b, _fmul(a, b)
+            for i in range(NLIMBS):
+                o_ref[i] = b[i]
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((NLIMBS, S, L), jnp.int32),
+        )
+
+    x = (np.arange(NLIMBS * S * L, dtype=np.int32)
+         .reshape(NLIMBS, S, L) % 1000)
+    fns = {}
+    for n in n_steps:
+        f = jax.jit(make(n))
+        np.asarray(f(x))
+        fns[n] = f
+    t1, t2 = timed(fns[n_steps[0]], x), timed(fns[n_steps[1]], x)
+    per = (t2 - t1) / (n_steps[1] - n_steps[0])
+    print(f"#   fmul chain tile={tile}: {per*1e6:.1f} us/fmul "
+          f"(~1330 tile-ops -> {per/1330*1e9:.0f} ns/tile-op)", flush=True)
+
+
+def main():
+    import jax
+
+    print(f"# devices: {jax.devices()}", flush=True)
+    probe_chain("add")
+    probe_chain("mul")
+    probe_chain("madd")
+    probe_chain("shift")
+    probe_chain("add", tile=(8, 128))
+    probe_fmul()
+    probe_fmul(tile=(8, 128))
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
