@@ -315,12 +315,34 @@ def main():
           f"backend={backend}", file=sys.stderr)
 
     if backend == "device" and depth > 1:
-        # warm the batched kernel too
+        # Warm the scheduler's device shapes (probe=2, chunk=8) OUTSIDE
+        # the racing scheduler — a first-shape compile takes minutes and
+        # the host lane would drain everything before the probe resolves —
+        # then one scheduled warm call, then clear any health state.
         from ed25519_consensus_tpu import batch as batch_mod
 
+        t0 = time.time()
+        # Warm what verify_many will actually dispatch: small-batch
+        # configs union-merge into super-batches with a DIFFERENT lane
+        # count, so warm the union shape for those.
+        warm_bv = rebuild_fresh(bv)
+        if bv.batch_size <= batch_mod._MERGE_MAX_BATCH:
+            per_union = max(
+                1, -(-batch_mod._MERGE_TARGET_SIGS // bv.batch_size))
+            warm_bv = batch_mod.merge_verifiers(
+                [rebuild_fresh(bv) for _ in range(min(per_union, depth))])
+        batch_mod.warm_device_shapes(warm_bv, rng=rng)
+        print(f"# warm_device_shapes({warm_bv.batch_size} sigs): "
+              f"{time.time()-t0:.1f}s", file=sys.stderr)
         batch_mod.verify_many(
             [rebuild_fresh(bv) for _ in range(depth)], rng=rng
         )
+        s = batch_mod.last_run_stats
+        print(f"# warm verify_many: device "
+              f"{s.get('device_batches', s.get('device_unions'))} "
+              f"/ host {s.get('host_batches', s.get('host_unions'))} "
+              f"(measured={s.get('device_measured')})", file=sys.stderr)
+        batch_mod.reset_device_health()
 
     def measure(run_backend, run_depth):
         best = float("inf")

@@ -257,14 +257,18 @@ class Verifier:
             msgs.append(msg)
             ra_parts.append(sig.R_bytes)
             ra_parts.append(vkb.to_bytes())
-        ks = native.bulk_challenges(b"".join(ra_parts), msgs)
-        if ks is NotImplemented:
+        kblob = native.bulk_challenges(b"".join(ra_parts), msgs, raw=True)
+        if kblob is NotImplemented:
             for vkb, sig, msg in zip(vkbs, sigs, msgs):
                 self.queue(Item.new(vkb, sig, msg))
             return
+        # Challenges stay as 32-byte canonical little-endian BYTES in the
+        # coalescing map (staging consumes bytes; int conversion on the
+        # hot queue path would cost ~0.8 µs/sig for nothing).
+        kmv = memoryview(kblob)
         sd = self.signatures.setdefault
-        for vkb, sig, k in zip(vkbs, sigs, ks):
-            sd(vkb, []).append((k, sig))
+        for i, (vkb, sig) in enumerate(zip(vkbs, sigs)):
+            sd(vkb, []).append((kmv[32 * i: 32 * i + 32], sig))
         self.batch_size += len(entries)
 
     # -- staging (host, exact) --------------------------------------------
@@ -302,9 +306,9 @@ class Verifier:
             sig.s_bytes for _, sigs in groups for _, sig in sigs
         )
         k_blob = b"".join(
-            k.to_bytes(32, "little")
+            k.to_bytes(32, "little") if type(k) is int else k
             for _, sigs in groups for k, _ in sigs
-        )
+        )  # challenges are ints (queue/Item) or 32-byte views (queue_bulk)
         if rng is None:
             z_blob = secrets.token_bytes(16 * n)
         else:
@@ -575,6 +579,12 @@ class _DeviceLane:
                         _msm.dispatch_window_sums_many(digits, pts)
                     )
             except Exception:  # device error: caller decides on host
+                import os as _os
+
+                if _os.environ.get("ED25519_TPU_DEBUG"):
+                    import traceback
+
+                    traceback.print_exc()
                 out = None
             # Report the CALL duration (lock acquired → fetch done), not
             # submit-to-finish: with 2 chunks pipelined, queue time would
@@ -599,6 +609,16 @@ def _shutdown_device_lane():
 import atexit  # noqa: E402  (registration belongs next to the lane)
 
 atexit.register(_shutdown_device_lane)
+
+
+def reset_device_health() -> None:
+    """Clear the device health state (deadline cooldown, uncompetitive
+    pause, stuck flag).  For benches and long-running services that know
+    a transient condition (tunnel outage, cold kernel compile) has
+    passed and want the next verify_many to probe the device again."""
+    _device_cooldown_until[0] = 0.0
+    _device_uncompetitive_until[0] = 0.0
+    _device_lane_stuck[0] = False
 
 
 def device_lane_stuck() -> bool:
@@ -757,14 +777,20 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         "host_batches": 0,
         "device_batches": 0,
         "device_sick": False,
+        "device_measured": False,  # a chunk completed and updated the EMA
         "seconds": 0.0,
     }
 
     def _finish(result):
         stats["seconds"] = _time.monotonic() - _t_begin
         if (stats["batches"] >= 8 and stats["device_batches"] == 0
+                and stats.get("device_measured")
                 and not stats["device_sick"] and stats["host_batches"]):
-            # the device lost every race this call: pause probing
+            # the device was MEASURED and still lost every race this
+            # call: pause probing.  An unresolved probe (e.g. first-call
+            # kernel compile still in flight when the host drained the
+            # pool) is NOT evidence of uncompetitiveness — the next call
+            # probes again against the now-warm kernel.
             _device_uncompetitive_until[0] = _time.monotonic() + 60.0
         last_run_stats.clear()
         last_run_stats.update(stats)
@@ -806,6 +832,23 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         ops = [s.device_operands(lambda n: pad) for s in staged]
         digits = np.stack([d for d, _ in ops])
         pts = np.stack([p for _, p in ops])
+        # Pad the batch axis to a FIXED shape (probe size or full chunk):
+        # every distinct (B, N) compiles its own kernel — minutes each on
+        # a remote-compile tunnel — so tail chunks must not mint new
+        # shapes.  Padding batches are zero digits on identity points
+        # (harmless, slightly wasted kernel time on tails).
+        target = 2 if len(idxs) <= 2 else chunk
+        if digits.shape[0] < target:
+            from .ops import limbs
+
+            nb = target - digits.shape[0]
+            digits = np.concatenate(
+                [digits, np.zeros((nb,) + digits.shape[1:], np.int8)]
+            )
+            ident = limbs.identity_point_batch(pts.shape[-1])
+            pts = np.concatenate(
+                [pts, np.stack([ident] * nb).astype(pts.dtype)]
+            )
         return idxs, digits, pts
 
     # Work-stealing pipeline.  The device lane is ONE worker thread that
@@ -864,6 +907,13 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         while outstanding:
             cid, idxs, t0 = outstanding[0]
             budget = max(3.0 * ema_per_batch * len(idxs), 2.0)
+            if ema_is_prior and hybrid:
+                # No measurement yet: the first call for a new shape
+                # compiles the kernel (minutes through a remote-compile
+                # tunnel) and must not be mistaken for a seized device.
+                # With the hybrid host lane covering throughput, a long
+                # first-call budget costs nothing.
+                budget = max(budget, 600.0)
             # The deadline clocks the device CALL, not queue time: while
             # the chunk waits behind another chunk or a direct caller
             # holding the device-call lock, allow a bounded extra wait
@@ -904,6 +954,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 ema_per_batch = per_batch if ema_is_prior else (
                     0.6 * ema_per_batch + 0.4 * per_batch)
                 ema_is_prior = False
+                stats["device_measured"] = True
                 for j, i in enumerate(idxs):
                     if decided[i]:
                         continue  # host stole this batch back first
@@ -953,7 +1004,28 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                         host_verify_one(undecided[-1])
                         stole = True
                         if len(undecided) == 1:  # chunk fully overtaken
-                            dev.discard(cid)
+                            # Before dropping an unmeasured young probe,
+                            # grace-wait briefly for its timing: the EMA
+                            # is what stops pointless re-probing (a call
+                            # young enough is running the kernel, not a
+                            # minutes-long first-shape compile).
+                            resolved = False
+                            t_start = dev.started_at(cid)
+                            if (ema_is_prior and t_start is not None
+                                    and _time.monotonic() - t_start < 3.0):
+                                res = dev.wait(cid, 3.0)
+                                if res is not _PENDING:
+                                    out, call_dt = res
+                                    if out is not None:
+                                        ema_per_batch = call_dt / max(
+                                            1, len(idxs))
+                                        ema_is_prior = False
+                                        stats["device_measured"] = True
+                                    else:
+                                        device_failed = True
+                                    resolved = True
+                            if not resolved:
+                                dev.discard(cid)
                             outstanding.pop(ci)
                         break
                 if not stole:
@@ -965,6 +1037,31 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         elif remaining:
             host_verify_one(remaining.pop())
     return _finish(verdicts)
+
+
+def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
+    """Compile the device kernels verify_many will dispatch for batches
+    shaped like `verifier`, OUTSIDE the racing scheduler.
+
+    The scheduler's probe and chunks use fixed batch shapes (2, N) and
+    (chunk, N); a first-shape compile takes minutes through a
+    remote-compile tunnel, during which the host lane drains every batch
+    and the probe never resolves — so benches/services should warm the
+    two shapes once, before the first racing call.  No-op (raises
+    nothing) if staging fails or no device backend is available."""
+    from .ops import msm
+
+    try:
+        staged = verifier._stage(rng)
+        pad = msm.preferred_pad(staged.n_device_terms)
+        d, p = staged.device_operands(lambda n: pad)
+        for B in sorted({2, chunk}):
+            dd = np.stack([d] * B)
+            pp = np.stack([p] * B)
+            with msm.DEVICE_CALL_LOCK:
+                np.asarray(msm.dispatch_window_sums_many(dd, pp))
+    except Exception:
+        return  # warming is an optimization; the scheduler still works
 
 
 def verify_single_many(entries, rng=None) -> "list[bool]":

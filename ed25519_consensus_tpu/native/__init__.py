@@ -189,8 +189,9 @@ def _decompress_batch_raw(lib, encodings):
     lib.zip215_decompress_batch(blob, n, out, ok)
     res = []
     buf = out.raw
+    okb = ok.raw  # .raw copies the whole buffer on EVERY access
     for i in range(n):
-        if ok.raw[i] == 0:
+        if okb[i] == 0:
             res.append(None)
             continue
         o = buf[128 * i : 128 * (i + 1)]
@@ -274,14 +275,16 @@ def stage_scalars(s_blob: bytes, k_blob: bytes, z_blob: bytes, n: int,
     if not ok:
         return None
     b_acc = int.from_bytes(b_out.raw, "little")
+    araw = a_out.raw  # one copy — .raw re-copies the buffer per access,
+    #                   which was ~40 ms/call at CometBFT-scale key counts
     a_accs = [
-        int.from_bytes(a_out.raw[56 * g: 56 * (g + 1)], "little")
+        int.from_bytes(araw[56 * g: 56 * (g + 1)], "little")
         for g in range(m)
     ]
     return b_acc, a_accs
 
 
-def _bulk_challenges_raw(lib, ra_blob: bytes, msgs) -> "list[int]":
+def _bulk_challenges_raw(lib, ra_blob: bytes, msgs, raw: bool = False):
     n = len(msgs)
     offs = (ctypes.c_uint64 * (n + 1))()
     total = 0
@@ -293,22 +296,27 @@ def _bulk_challenges_raw(lib, ra_blob: bytes, msgs) -> "list[int]":
     out = ctypes.create_string_buffer(32 * n)
     lib.bulk_challenges(ra_blob, msg_blob,
                         ctypes.cast(offs, ctypes.c_char_p), n, out)
-    raw = out.raw
-    return [int.from_bytes(raw[32 * i: 32 * i + 32], "little")
+    blob = out.raw
+    if raw:
+        return blob
+    return [int.from_bytes(blob[32 * i: 32 * i + 32], "little")
             for i in range(n)]
 
 
-def bulk_challenges(ra_blob: bytes, msgs):
+def bulk_challenges(ra_blob: bytes, msgs, raw: bool = False):
     """Challenge scalars k_i = SHA-512(R_i ‖ A_i ‖ msg_i) mod ℓ for a
     whole stream in ONE native call (the per-item hash the reference
     computes at queue time, src/batch.rs:85-91).  `ra_blob` is n
     concatenated 64-byte R‖A rows; `msgs` the matching message list.
-    Returns list[int], or NotImplemented when the native library is
-    unavailable (caller falls back to hashlib per item)."""
+    Returns list[int] — or, with `raw`, the packed n×32-byte canonical
+    little-endian blob (the staging layer consumes bytes anyway, so raw
+    skips n bigint conversions on the hot queue path).  Returns
+    NotImplemented when the native library is unavailable (caller falls
+    back to hashlib per item)."""
     lib = load()
     if lib is None:
         return NotImplemented
-    return _bulk_challenges_raw(lib, ra_blob, msgs)
+    return _bulk_challenges_raw(lib, ra_blob, msgs, raw=raw)
 
 
 def point_from_raw(row) -> "object":

@@ -31,9 +31,7 @@ def reset_device_state():
     if inst is not None and inst.healthy():
         inst.shutdown(timeout=5.0)
     batch._DeviceLane._instance = None
-    batch._device_cooldown_until[0] = 0.0
-    batch._device_uncompetitive_until[0] = 0.0
-    batch._device_lane_stuck[0] = False
+    batch.reset_device_health()
     batch.last_run_stats.clear()
 
 
