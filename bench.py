@@ -212,6 +212,11 @@ def sweep(backend: str):
             for vkb, sig, m in items_distinct:
                 batch.Item.new(vkb, sig, m).verify_single()
 
+        def unbatched_bulk():
+            # per-signature verdicts via the union-RLC path — the
+            # framework's bulk answer to the per-call verify loop
+            assert all(batch.verify_single_many(items_distinct, rng=rng))
+
         def batched(items):
             bv = batch.Verifier()
             for it in items:
@@ -221,10 +226,12 @@ def sweep(backend: str):
         # warm any kernel compiles outside the timed region
         batched(items_distinct)
         modes["unbatched"] = best(unbatched)
+        modes["unbatched_bulk"] = best(unbatched_bulk)
         modes["batch_distinct"] = best(lambda: batched(items_distinct))
         modes["batch_same_key"] = best(lambda: batched(items_same))
         rows.append((n, modes))
         print(f"# n={n:3d}  unbatched {modes['unbatched']:8.0f}/s   "
+              f"bulk {modes['unbatched_bulk']:8.0f}/s   "
               f"distinct {modes['batch_distinct']:8.0f}/s   "
               f"same-key {modes['batch_same_key']:8.0f}/s",
               file=sys.stderr)
