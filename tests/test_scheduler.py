@@ -335,3 +335,32 @@ def test_merge_does_not_mutate_members():
         lst.clear()
     assert [v.batch_size for v in vs] == sizes
     assert all(len(lst) for v in vs for lst in v.signatures.values())
+
+
+def test_warm_device_shapes_compiles_scheduler_shapes(monkeypatch):
+    """warm_device_shapes must dispatch exactly the scheduler's two batch
+    shapes (probe=2, chunk) for the verifier's padded lane count, and
+    never raise on failure."""
+    import numpy as np
+
+    shapes = []
+
+    def spy(digits, pts):
+        # stub result: warm_device_shapes only np.asarray's it, so a
+        # real (compile-heavy) dispatch adds nothing to this contract
+        shapes.append(digits.shape)
+        return np.zeros((digits.shape[0], 4, 20, digits.shape[1]),
+                        dtype=np.int32)
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", spy)
+    vs = make_verifiers(1, sigs_per_batch=3)
+    batch.warm_device_shapes(vs[0], rng=rng, chunk=4)
+    assert sorted(s[0] for s in shapes) == [2, 4]
+    assert len({s[1:] for s in shapes}) == 1  # same (nwin, N) both times
+
+    # failure safety: a raising dispatch must not propagate
+    def boom(digits, pts):
+        raise RuntimeError("no device")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
+    batch.warm_device_shapes(vs[0], rng=rng)  # must not raise

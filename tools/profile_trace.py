@@ -1,0 +1,90 @@
+"""Capture a jax profiler trace of the device MSM dispatch and summarize
+where device time goes (SURVEY.md §5 tracing/profiling; fills the gap the
+per-stage host timers in utils/metrics.py can't see — on-device op time).
+
+Writes the raw trace under --out (TensorBoard/Perfetto-compatible
+xplane.pb + trace.json.gz) and prints the top device events by total
+duration, so kernel work (Mosaic program), infeed/outfeed, and gaps are
+attributable without any external tooling.
+
+Usage: python tools/profile_trace.py [--n 4096] [--batches 2]
+       [--out bench_artifacts/trace]
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def summarize(trace_dir, top=18):
+    """Aggregate the Chrome-trace events by name → (count, total µs)."""
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        print("# no trace.json.gz found", flush=True)
+        return []
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    agg = defaultdict(lambda: [0, 0.0])
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        name = ev.get("name", "?")
+        agg[name][0] += 1
+        agg[name][1] += ev["dur"]
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    width = max((len(n) for n, _ in rows), default=10)
+    print(f"# {'event':{width}}  count  total_ms", flush=True)
+    for name, (cnt, dur) in rows:
+        print(f"# {name:{width}}  {cnt:5d}  {dur/1000:8.2f}", flush=True)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--batches", type=int, default=2)
+    ap.add_argument("--out", default="bench_artifacts/trace")
+    args = ap.parse_args()
+
+    import random
+
+    import jax
+
+    from ed25519_consensus_tpu.ops import edwards, msm
+
+    print(f"# devices: {jax.devices()}", flush=True)
+    rng = random.Random(11)
+    pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, 2**252))
+           for _ in range(64)]
+    pts = [pts[i % 64] for i in range(args.n)]
+    sc = [rng.randrange(2**128) for _ in range(args.n)]
+    digits, packed = msm.pack_msm_operands(
+        sc, pts, n_lanes=msm.preferred_pad(args.n))
+    dd = np.stack([digits] * args.batches)
+    pp = np.stack([packed] * args.batches)
+    t0 = time.time()
+    np.asarray(msm.dispatch_window_sums_many(dd, pp))  # warm/compile
+    print(f"# warm dispatch: {time.time()-t0:.1f}s", flush=True)
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(3):
+            np.asarray(msm.dispatch_window_sums_many(dd, pp))
+    print(f"# trace written to {args.out}", flush=True)
+    summarize(args.out)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
