@@ -832,16 +832,19 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         ops = [s.device_operands(lambda n: pad) for s in staged]
         digits = np.stack([d for d, _ in ops])
         pts = np.stack([p for _, p in ops])
-        # Pad the batch axis to a FIXED shape (probe size or full chunk):
-        # every distinct (B, N) compiles its own kernel — minutes each on
-        # a remote-compile tunnel — so tail chunks must not mint new
-        # shapes.  Padding batches are zero digits on identity points
-        # (harmless, slightly wasted kernel time on tails).
-        target = 2 if len(idxs) <= 2 else chunk
-        if digits.shape[0] < target:
+        # Pad the batch axis to ONE fixed shape — the full chunk — for
+        # EVERY dispatch (probe and tails included).  Two reasons, both
+        # measured on the tunneled chip: every distinct (B, N) compiles
+        # its own kernel (minutes each), and SWITCHING between resident
+        # executables can stall a call for seconds, which is what kept
+        # discarding the probe.  Padding batches are zero digits on
+        # identity points; the probe thereby pays a full-chunk kernel
+        # call, which is exactly the per-chunk economics the EMA should
+        # measure anyway.
+        if digits.shape[0] < chunk:
             from .ops import limbs
 
-            nb = target - digits.shape[0]
+            nb = chunk - digits.shape[0]
             digits = np.concatenate(
                 [digits, np.zeros((nb,) + digits.shape[1:], np.int8)]
             )
@@ -884,7 +887,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
 
     ema_per_batch = 0.2  # seconds per batch; pessimistic prior
     ema_is_prior = True
-    outstanding = []  # [(chunk_id, idxs, t_submit)]
+    outstanding = []  # [(chunk_id, real idxs, t_submit, padded batches)]
     device_sick = False
     device_failed = False  # an error chunk: stop using the device this call
 
@@ -897,7 +900,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             return
         idxs, digits, pts = pending
         cid = dev.submit(digits, pts)
-        outstanding.append((cid, idxs, _time.monotonic()))
+        # (chunk id, real batch indices, submit time, padded batch count)
+        outstanding.append((cid, idxs, _time.monotonic(),
+                            digits.shape[0]))
 
     def poll(block: bool):
         """Apply finished chunk results; returns True if progress.  On a
@@ -905,8 +910,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         nonlocal device_sick, device_failed, ema_per_batch, ema_is_prior
         progress = False
         while outstanding:
-            cid, idxs, t0 = outstanding[0]
-            budget = max(3.0 * ema_per_batch * len(idxs), 2.0)
+            cid, idxs, t0, padded_b = outstanding[0]
+            budget = max(3.0 * ema_per_batch * padded_b, 2.0)
             if ema_is_prior and hybrid:
                 # No measurement yet: the first call for a new shape
                 # compiles the kernel (minutes through a remote-compile
@@ -934,7 +939,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 stats["device_sick"] = True
                 _device_cooldown_until[0] = _time.monotonic() + 30.0
                 dev.abandon()
-                for _, idxs2, _t in outstanding:
+                for _, idxs2, _t, _b in outstanding:
                     for i in idxs2:
                         host_verify_one(i)
                 outstanding.clear()
@@ -948,9 +953,11 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                     host_verify_one(i)
             else:
                 # EMA over the device CALL time (the lane worker measures
-                # it) — queue time behind a pipelined sibling chunk is not
-                # device cost.
-                per_batch = call_dt / max(1, len(idxs))
+                # it) per PADDED batch — a padded probe pays exactly a
+                # full chunk's kernel, so this is the steady-state
+                # per-batch device cost, and queue time behind a
+                # pipelined sibling chunk is excluded.
+                per_batch = call_dt / max(1, padded_b)
                 ema_per_batch = per_batch if ema_is_prior else (
                     0.6 * ema_per_batch + 0.4 * per_batch)
                 ema_is_prior = False
@@ -980,7 +987,10 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # device lane: one probe chunk first; keep up to two chunks
         # queued only while the device beats the host per batch
         if remaining and not outstanding and not probed:
-            submit(size=min(2, chunk))  # cheap probe: 2 batches
+            # probe: 2 real batches padded to the full chunk shape — pays
+            # one chunk-shaped kernel call and measures exactly the
+            # steady-state per-chunk economics
+            submit(size=min(2, chunk))
             probed = True
         while (remaining and len(outstanding) < 2 and not device_failed
                and not ema_is_prior and device_competitive()):
@@ -998,7 +1008,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 # the math is identical either way.
                 stole = False
                 for ci in range(len(outstanding) - 1, -1, -1):
-                    cid, idxs, _t0 = outstanding[ci]
+                    cid, idxs, _t0, padded_b = outstanding[ci]
                     undecided = [i for i in idxs if not decided[i]]
                     if undecided:
                         host_verify_one(undecided[-1])
@@ -1018,7 +1028,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                                     out, call_dt = res
                                     if out is not None:
                                         ema_per_batch = call_dt / max(
-                                            1, len(idxs))
+                                            1, padded_b)
                                         ema_is_prior = False
                                         stats["device_measured"] = True
                                     else:
@@ -1040,26 +1050,29 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
 
 
 def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
-    """Compile the device kernels verify_many will dispatch for batches
-    shaped like `verifier`, OUTSIDE the racing scheduler.
+    """Compile the ONE device kernel shape verify_many dispatches for
+    batches shaped like `verifier`, OUTSIDE the racing scheduler.
 
-    The scheduler's probe and chunks use fixed batch shapes (2, N) and
-    (chunk, N); a first-shape compile takes minutes through a
+    Every scheduler dispatch (probe included) is padded to the fixed
+    (chunk, N) batch shape; a first-shape compile takes minutes through a
     remote-compile tunnel, during which the host lane drains every batch
     and the probe never resolves — so benches/services should warm the
-    two shapes once, before the first racing call.  No-op (raises
-    nothing) if staging fails or no device backend is available."""
+    shape once, before the first racing call.  No-op (raises nothing) if
+    staging fails or no device backend is available."""
     from .ops import msm
 
     try:
         staged = verifier._stage(rng)
         pad = msm.preferred_pad(staged.n_device_terms)
         d, p = staged.device_operands(lambda n: pad)
-        for B in sorted({2, chunk}):
-            dd = np.stack([d] * B)
-            pp = np.stack([p] * B)
-            with msm.DEVICE_CALL_LOCK:
-                np.asarray(msm.dispatch_window_sums_many(dd, pp))
+        # verify_many pads every dispatch (probe included) to the full
+        # chunk shape, so ONE executable covers the whole schedule —
+        # switching between resident executables stalls calls for
+        # seconds on tunneled devices (measured).
+        dd = np.stack([d] * chunk)
+        pp = np.stack([p] * chunk)
+        with msm.DEVICE_CALL_LOCK:
+            np.asarray(msm.dispatch_window_sums_many(dd, pp))
     except Exception:
         return  # warming is an optimization; the scheduler still works
 

@@ -285,17 +285,16 @@ def stage_scalars(s_blob: bytes, k_blob: bytes, z_blob: bytes, n: int,
 
 
 def _bulk_challenges_raw(lib, ra_blob: bytes, msgs, raw: bool = False):
+    import numpy as np
+
     n = len(msgs)
-    offs = (ctypes.c_uint64 * (n + 1))()
-    total = 0
-    for i, m in enumerate(msgs):
-        offs[i] = total
-        total += len(m)
-    offs[n] = total
+    offs = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum(np.fromiter(map(len, msgs), dtype=np.uint64, count=n),
+              out=offs[1:])
     msg_blob = b"".join(msgs)
     out = ctypes.create_string_buffer(32 * n)
     lib.bulk_challenges(ra_blob, msg_blob,
-                        ctypes.cast(offs, ctypes.c_char_p), n, out)
+                        offs.ctypes.data_as(ctypes.c_char_p), n, out)
     blob = out.raw
     if raw:
         return blob
