@@ -567,6 +567,13 @@ class _DeviceLane:
             cid, digits, pts = item
             import time as _time
 
+            with self._cv:
+                if cid in self._discarded:
+                    # caller already decided on the host (e.g. a leftover
+                    # chunk from a finished verify_many): don't waste a
+                    # device call on it
+                    self._discarded.discard(cid)
+                    continue
             t_call = None
             try:
                 # One critical section across launch + blocking fetch (the

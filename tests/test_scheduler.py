@@ -369,3 +369,36 @@ def test_warm_device_shapes_compiles_scheduler_shapes(monkeypatch):
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
     batch.warm_device_shapes(vs[0], rng=rng)  # must not raise
+
+
+def test_discarded_queued_chunk_is_never_dispatched(monkeypatch):
+    """A chunk discarded while still QUEUED (e.g. leftover from a finished
+    call) must be dropped by the worker without a device call."""
+    import numpy as np
+
+    gate = threading.Event()
+    calls = []
+
+    def gated(digits, pts):
+        calls.append(digits.shape[0])
+        gate.wait(timeout=10.0)
+        return np.zeros((digits.shape[0], 4, 20, digits.shape[1]),
+                        dtype=np.int32)
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", gated)
+    lane = batch._DeviceLane.get()
+    d = np.zeros((1, 33, 8), dtype=np.int8)
+    p = np.zeros((1, 4, 20, 8), dtype=np.int16)
+    first = lane.submit(d, p)     # occupies the worker (blocks on gate)
+    time.sleep(0.1)
+    queued = lane.submit(d, p)    # still in the queue
+    lane.discard(queued)          # discarded before the worker reaches it
+    gate.set()
+    res = lane.wait(first, 10.0)
+    assert res is not batch._PENDING
+    deadline = time.monotonic() + 5.0
+    while len(calls) < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.3)  # give the worker time to (incorrectly) run the 2nd
+    assert calls == [1], calls  # exactly one dispatch: the first chunk
+    assert not lane._results or queued not in lane._results

@@ -119,3 +119,27 @@ def test_individual_matches_batch_verification():
             f"batch/individual divergence: vk={A_bytes.hex()} "
             f"sig={sig_bytes.hex()}"
         )
+
+
+def test_matrix_through_verify_single_many():
+    """The whole 196-case matrix through the BULK per-signature path
+    (batch.verify_single_many: union-RLC + bisection) must reproduce the
+    analytic ZIP215 verdicts case by case — mixed with tampered valid
+    signatures so the union actually fails and bisection has to isolate
+    torsion cases from honest ones."""
+    from ed25519_consensus_tpu import SigningKey
+
+    rng = random.Random(0x215B)
+    entries, want = [], []
+    for i, (A_bytes, sig_bytes, _, valid_zip215) in enumerate(CASES):
+        entries.append((A_bytes, Signature.from_bytes(sig_bytes), MSG))
+        want.append(valid_zip215)
+        if i % 28 == 7:  # sprinkle honest and tampered sigs between cases
+            sk = SigningKey.new(rng)
+            msg = b"mix-%d" % i
+            good = i % 56 == 7
+            sig = sk.sign(msg if good else b"evil")
+            entries.append((sk.verification_key_bytes(), sig, msg))
+            want.append(good)
+    got = batch.verify_single_many(entries, rng=rng)
+    assert got == want
