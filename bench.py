@@ -302,22 +302,51 @@ def main():
                   f"{n/dt:.0f} sigs/s", file=sys.stderr)
 
     # Warmup (compiles the kernel for this batch's padded lane count).
-    # The remote-compile tunnel is occasionally flaky: retry once, then
-    # fall back to the host backend rather than failing the bench.
+    # The remote-compile tunnel is occasionally flaky OR arbitrarily slow:
+    # retry errors once, cap wall time with a watchdog thread, then fall
+    # back to the host backend rather than failing (or outlasting) the
+    # bench.  A timed-out warm thread keeps the device-call lock, so the
+    # device lane simply sits out the rest of this process.
+    import threading
+
     backend = args.backend
     t0 = time.time()
+
+    def _timed(fn, cap):
+        done = threading.Event()
+        err = []
+
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - resilience path
+                err.append(e)
+            done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        if not done.wait(timeout=cap):
+            return "timeout"
+        return err[0] if err else None
+
     for attempt in (1, 2, 3):
-        try:
-            rebuild_fresh(bv).verify(rng=rng, backend=backend)
+        res = _timed(
+            lambda: rebuild_fresh(bv).verify(rng=rng, backend=backend),
+            cap=1200 if attempt == 1 else 300,
+        )
+        if res is None:
             break
-        except Exception as e:  # noqa: BLE001 - resilience path
-            print(f"# warmup attempt {attempt} on backend={backend} "
-                  f"failed: {type(e).__name__}: {str(e)[:120]}",
-                  file=sys.stderr)
-            if attempt == 2 and backend != "host":
+        print(f"# warmup attempt {attempt} on backend={backend} "
+              f"failed: {res if res == 'timeout' else type(res).__name__}"
+              f": {str(res)[:120]}", file=sys.stderr)
+        if res == "timeout" or attempt >= 2:
+            if backend != "host":
                 backend = "host"
-            elif attempt == 3:
-                raise
+            else:
+                raise RuntimeError(f"host warmup failed: {res}") from (
+                    None if res == "timeout" else res)
+    if backend == "host":
+        depth = 1  # host fallback measures one batch per run — a stale
+        #            pipeline depth would divide the time by 16
     print(f"# warmup (compile+run): {time.time()-t0:.1f}s "
           f"backend={backend}", file=sys.stderr)
 
@@ -338,24 +367,22 @@ def main():
                 1, -(-batch_mod._MERGE_TARGET_SIGS // bv.batch_size))
             warm_bv = batch_mod.merge_verifiers(
                 [rebuild_fresh(bv) for _ in range(min(per_union, depth))])
-        import threading
-
-        warm_done = threading.Event()
-
-        def _warm():
-            batch_mod.warm_device_shapes(warm_bv, rng=rng)
-            warm_done.set()
-
         # A seized tunnel can hang the blocking warm fetch forever; cap it
         # so the bench always reaches its measurements (an abandoned warm
         # thread holds the device-call lock, so the device lane just sits
         # out this process and the host path carries the bench).
-        threading.Thread(target=_warm, daemon=True).start()
-        finished = warm_done.wait(timeout=1500)
+        res = _timed(
+            lambda: batch_mod.warm_device_shapes(warm_bv, rng=rng),
+            cap=600,
+        )
+        if res is None:
+            note = ""
+        elif res == "timeout":
+            note = " (TIMED OUT — device lane will sit out this process)"
+        else:
+            note = (f" (FAILED: {type(res).__name__}: {str(res)[:120]})")
         print(f"# warm_device_shapes({warm_bv.batch_size} sigs): "
-              f"{time.time()-t0:.1f}s"
-              + ("" if finished else " (TIMED OUT — device lane will sit "
-                 "out this process)"), file=sys.stderr)
+              f"{time.time()-t0:.1f}s{note}", file=sys.stderr)
         batch_mod.verify_many(
             [rebuild_fresh(bv) for _ in range(depth)], rng=rng
         )
