@@ -141,3 +141,36 @@ def test_native_msm_niels_boundary_parity():
         sc[0] = 0
         assert native.vartime_msm(sc, pts) == \
             edwards.multiscalar_mul(sc, pts), n
+
+
+def test_bulk_challenges_parity_across_padding_boundaries():
+    """Native SHA-512 + wide mod-ℓ reduction (bulk_challenges) must match
+    hashlib + Python from_hash for every message length spanning the
+    SHA-512 padding boundaries (the 64-byte R‖A prefix makes total input
+    64+len: lengths 0..200 cross the 1-block/2-block/3-block edges at
+    111-112 and 239-240 total bytes), plus the raw-bytes fast path."""
+    import hashlib
+
+    from ed25519_consensus_tpu import native
+    from ed25519_consensus_tpu.ops import scalar
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng2 = random.Random(0x5AD)
+    msgs = [bytes(rng2.randrange(256) for _ in range(n))
+            for n in list(range(0, 200)) + [300, 1024]]
+    ra = b"".join(bytes(rng2.randrange(256) for _ in range(64))
+                  for _ in msgs)
+    ks = native.bulk_challenges(ra, msgs)
+    kblob = native.bulk_challenges(ra, msgs, raw=True)
+    for i, m in enumerate(msgs):
+        h = hashlib.sha512()
+        h.update(ra[64 * i: 64 * i + 32])
+        h.update(ra[64 * i + 32: 64 * i + 64])
+        h.update(m)
+        want = scalar.from_hash(h)
+        assert ks[i] == want, (i, len(m))
+        assert int.from_bytes(kblob[32 * i: 32 * i + 32],
+                              "little") == want, (i, len(m))

@@ -40,6 +40,30 @@ for i in range(8):
     bv.queue((s.verification_key_bytes(), s.sign(m), m))
 bv.verify(rng=rng, backend="host")
 
+# streaming/bulk surface must also work jax-free (host lane only):
+# queue_bulk (native challenge hashing), union-merged verify_many, and
+# per-signature bulk verdicts
+import os
+os.environ["ED25519_TPU_DISABLE_DEVICE"] = "1"
+streams = []
+for b in range(6):
+    v = batch.Verifier()
+    ents = []
+    for i in range(4):
+        s = SigningKey.new(rng)
+        m = b"stream %d %d" % (b, i)
+        ents.append((s.verification_key_bytes(),
+                     s.sign(m if b != 4 or i != 1 else b"evil"), m))
+    v.queue_bulk(ents)
+    streams.append(v)
+assert batch.verify_many(streams, rng=rng) == [b != 4 for b in range(6)]
+sk2 = SigningKey.new(rng)
+flags = batch.verify_single_many(
+    [(sk2.verification_key_bytes(), sk2.sign(b"a"), b"a"),
+     (sk2.verification_key_bytes(), sk2.sign(b"b"), b"c")], rng=rng)
+assert flags == [True, False], flags
+del os.environ["ED25519_TPU_DISABLE_DEVICE"]
+
 # device backend must fail CLEANLY (NotImplementedError), not crash
 bv2 = batch.Verifier()
 bv2.queue((sk.verification_key_bytes(), sig, b"core without jax"))
