@@ -558,6 +558,8 @@ class _DeviceLane:
         self._thread.join(timeout)
 
     def _run(self):
+        import time as _time
+
         from .ops import msm as _msm
 
         while True:
@@ -565,8 +567,6 @@ class _DeviceLane:
             if item is None:
                 return
             cid, digits, pts = item
-            import time as _time
-
             with self._cv:
                 if cid in self._discarded:
                     # caller already decided on the host (e.g. a leftover
