@@ -1,0 +1,130 @@
+"""Randomized soak: the streaming surfaces against the per-call oracle.
+
+Generates rounds of randomized workloads — variable batch sizes (0..~300),
+valid/tampered/malformed/non-canonical/torsion signatures, repeated keys,
+duplicate entries — and checks that `batch.verify_many` (union-merge +
+bisection + scheduler) and `batch.verify_single_many` agree exactly with
+the per-call ZIP215 verdicts.  Consensus software lives or dies on this
+agreement; the fixed seed makes any failure reproducible.
+
+Usage: python tools/soak.py [--rounds 40] [--seed 0xD00D]
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("ED25519_TPU_DISABLE_DEVICE", "1")
+
+from ed25519_consensus_tpu import (  # noqa: E402
+    InvalidSignature, MalformedPublicKey, Signature, SigningKey,
+    VerificationKey, batch)
+from ed25519_consensus_tpu.ops import edwards  # noqa: E402
+from ed25519_consensus_tpu.ops.scalar import L  # noqa: E402
+from ed25519_consensus_tpu.utils import fixtures  # noqa: E402
+
+
+def oracle(vkb, sig, msg) -> bool:
+    """Per-call reference verdict (the reference's verify loop).  Catches
+    ONLY the library's rejection exceptions — any other exception is a
+    real bug and must crash the soak, not read as 'invalid'."""
+    try:
+        VerificationKey.from_bytes(vkb).verify(
+            sig if isinstance(sig, Signature) else Signature.from_bytes(sig),
+            msg)
+        return True
+    except (InvalidSignature, MalformedPublicKey):
+        return False
+
+
+def random_entry(rng, keys, torsion_encs):
+    """One randomized (vkb, sig, msg) entry, adversarial with prob ~1/3."""
+    roll = rng.random()
+    sk = rng.choice(keys)
+    msg = b"soak-%d" % rng.getrandbits(48)
+    if roll < 0.55:
+        return (sk.verification_key_bytes(), sk.sign(msg), msg)
+    if roll < 0.70:  # tampered
+        return (sk.verification_key_bytes(), sk.sign(b"evil"), msg)
+    if roll < 0.80:  # torsion/non-canonical A and R, s = 0 (ZIP215-valid)
+        enc = rng.choice(torsion_encs)
+        return (enc, Signature(rng.choice(torsion_encs), b"\x00" * 32),
+                b"Zcash")
+    if roll < 0.88:  # s >= l (must reject)
+        sig = sk.sign(msg)
+        return (sk.verification_key_bytes(),
+                Signature(sig.R_bytes, int(L).to_bytes(32, "little")), msg)
+    if roll < 0.94:  # non-point key (must reject)
+        return (b"\x02" + b"\x00" * 31, sk.sign(msg), msg)
+    # duplicate-prone: fixed message, fixed key
+    return (keys[0].verification_key_bytes(), keys[0].sign(b"dup"), b"dup")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--seed", type=lambda s: int(s, 0), default=0xD00D)
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+    keys = [SigningKey.new(rng) for _ in range(24)]
+    torsion_encs = [p.compress() for p in edwards.eight_torsion()]
+    torsion_encs += fixtures.non_canonical_point_encodings()[:6]
+
+    t_start = time.time()
+    total_batches = total_sigs = 0
+    for rnd in range(args.rounds):
+        n_batches = rng.randrange(1, 24)
+        stream, expect = [], []
+        flat, flat_expect = [], []
+        for _ in range(n_batches):
+            n = rng.choice([0, 1, 2, 3, 8, 32, 64, 150, 300])
+            entries = [random_entry(rng, keys, torsion_encs)
+                       for _ in range(n)]
+            v = batch.Verifier()
+            if rng.random() < 0.5:
+                v.queue_bulk(entries)
+            else:
+                for e in entries:
+                    v.queue(e)  # parsing never validates (deferred)
+            # exact expectation: every queued entry must verify
+            batch_ok = True
+            for e in entries:
+                ok = oracle(*e)
+                if rng.random() < 0.1:
+                    flat.append(e)
+                    flat_expect.append(ok)
+                batch_ok = batch_ok and ok
+            expect.append(batch_ok)
+            stream.append(v)
+            total_sigs += v.batch_size
+        total_batches += n_batches
+        merge = rng.choice(["auto", "always", "never"])
+        got = batch.verify_many(stream, rng=rng, merge=merge,
+                                chunk=rng.choice([2, 4, 8]))
+        # explicit raises (not assert): the checks must survive python -O
+        if got != expect:
+            raise SystemExit(
+                f"round {rnd}: verify_many(merge={merge}) mismatch\n"
+                f"got    {got}\nexpect {expect}")
+        if flat:
+            got_flat = batch.verify_single_many(flat, rng=rng)
+            if got_flat != flat_expect:
+                raise SystemExit(
+                    f"round {rnd}: verify_single_many mismatch")
+        if rnd % 10 == 0:
+            print(f"# round {rnd}: {n_batches} batches ok "
+                  f"(cumulative {total_sigs} sigs)", flush=True)
+    print(f"SOAK OK: {args.rounds} rounds, {total_batches} batches, "
+          f"{total_sigs} sigs in {time.time()-t_start:.0f}s "
+          f"(seed {args.seed:#x})")
+    sys.stdout.flush()
+    if batch.device_lane_stuck():
+        os._exit(0)  # a stuck lane thread would abort normal teardown
+
+
+if __name__ == "__main__":
+    main()
