@@ -9,7 +9,7 @@ device meshes for large batches.
 
 Public surface mirrors reference src/lib.rs:6-16."""
 
-from . import batch
+from . import batch, serde
 from .error import (
     Error,
     InvalidSignature,
@@ -34,4 +34,5 @@ __all__ = [
     "VerificationKey",
     "VerificationKeyBytes",
     "batch",
+    "serde",
 ]

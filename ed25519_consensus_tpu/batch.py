@@ -20,6 +20,7 @@ TPU/JAX limb kernel (`backend="device"`, see ops/msm.py)."""
 
 import hashlib
 import secrets
+import threading
 
 import numpy as np
 
@@ -35,6 +36,13 @@ def gen_u128(rng=None) -> int:
     if rng is None:
         return secrets.randbits(128)
     return rng.getrandbits(128)
+
+
+def challenge_int(k) -> int:
+    """Normalize a challenge from the `Verifier.signatures` map to an int
+    (the map stores ints from `queue` and 32-byte little-endian buffers
+    from `queue_bulk` — see the Verifier docstring invariant)."""
+    return k if type(k) is int else int.from_bytes(bytes(k), "little")
 
 
 def _as_item(value) -> "Item":
@@ -218,7 +226,15 @@ class StagedBatch:
 
 
 class Verifier:
-    """A batch verification context (reference src/batch.rs:110-218)."""
+    """A batch verification context (reference src/batch.rs:110-218).
+
+    INVARIANT on `signatures` (the public coalescing map): values are
+    lists of `(k, sig)` where the challenge `k` is EITHER an int
+    (`queue` / `Item`) OR a 32-byte canonical little-endian buffer
+    (bytes/memoryview, from `queue_bulk`'s one-native-call hash path).
+    Every consumer must accept both — the internal ones (`_stage`,
+    union-merge, the per-item fallback) normalize inline on their hot
+    paths; external consumers should use `challenge_int`."""
 
     def __init__(self):
         # vk_bytes -> list of (k, sig); insertion-ordered grouping is the
@@ -481,12 +497,17 @@ class _DeviceLane:
     process) and a fresh lane is created after the health cooldown."""
 
     _instance = None
+    _instance_lock = threading.Lock()
 
     @classmethod
     def get(cls) -> "_DeviceLane":
-        if cls._instance is None or not cls._instance.healthy():
-            cls._instance = cls()
-        return cls._instance
+        # Two concurrent verify_many callers must not each build a lane:
+        # duplicate workers would contend for DEVICE_CALL_LOCK and orphan
+        # one thread per race.
+        with cls._instance_lock:
+            if cls._instance is None or not cls._instance.healthy():
+                cls._instance = cls()
+            return cls._instance
 
     def __init__(self):
         import queue
@@ -508,8 +529,9 @@ class _DeviceLane:
         return self._thread.is_alive() and not self._abandoned
 
     def submit(self, digits, pts) -> int:
-        cid = self._next_id
-        self._next_id += 1
+        with self._cv:
+            cid = self._next_id
+            self._next_id += 1
         self._q.put((cid, digits, pts))
         return cid
 
@@ -548,7 +570,12 @@ class _DeviceLane:
     def abandon(self) -> None:
         self._abandoned = True
         _device_lane_stuck[0] = True
-        type(self)._instance = None
+        # Clear the singleton only if it is still THIS lane: a second
+        # caller's stale abandon must not discard a freshly rebuilt
+        # healthy lane (and orphan its worker).
+        with type(self)._instance_lock:
+            if type(self)._instance is self:
+                type(self)._instance = None
 
     def shutdown(self, timeout: float = 5.0) -> None:
         """Stop the worker before interpreter teardown: a thread parked
@@ -585,6 +612,9 @@ class _DeviceLane:
                     out = np.asarray(
                         _msm.dispatch_window_sums_many(digits, pts)
                     )
+                # Fetch done ⇒ any first-compile for this shape is over:
+                # subsequent calls are held to the normal deadline.
+                _msm.mark_shape_completed(digits.shape[0], digits.shape[2])
             except Exception:  # device error: caller decides on host
                 import os as _os
 
@@ -907,9 +937,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             return
         idxs, digits, pts = pending
         cid = dev.submit(digits, pts)
-        # (chunk id, real batch indices, submit time, padded batch count)
+        # (chunk id, real batch idxs, submit time, padded shape (B, N))
         outstanding.append((cid, idxs, _time.monotonic(),
-                            digits.shape[0]))
+                            digits.shape[0], digits.shape[2]))
 
     def poll(block: bool):
         """Apply finished chunk results; returns True if progress.  On a
@@ -917,14 +947,16 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         nonlocal device_sick, device_failed, ema_per_batch, ema_is_prior
         progress = False
         while outstanding:
-            cid, idxs, t0, padded_b = outstanding[0]
+            cid, idxs, t0, padded_b, n_lanes = outstanding[0]
             budget = max(3.0 * ema_per_batch * padded_b, 2.0)
-            if ema_is_prior and hybrid:
-                # No measurement yet: the first call for a new shape
-                # compiles the kernel (minutes through a remote-compile
-                # tunnel) and must not be mistaken for a seized device.
-                # With the hybrid host lane covering throughput, a long
-                # first-call budget costs nothing.
+            if ema_is_prior and not msm.shape_completed(padded_b, n_lanes):
+                # No measurement yet AND no call for this padded shape has
+                # ever completed: the call may be sitting in a first-shape
+                # kernel compile (minutes through a remote-compile tunnel)
+                # and must not be mistaken for a seized device.  Applies in
+                # BOTH hybrid modes — once any call for the shape has
+                # completed, a stalled device gets the normal short
+                # deadline even before the first EMA measurement.
                 budget = max(budget, 600.0)
             # The deadline clocks the device CALL, not queue time: while
             # the chunk waits behind another chunk or a direct caller
@@ -946,7 +978,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 stats["device_sick"] = True
                 _device_cooldown_until[0] = _time.monotonic() + 30.0
                 dev.abandon()
-                for _, idxs2, _t, _b in outstanding:
+                for _, idxs2, _t, _b, _nl in outstanding:
                     for i in idxs2:
                         host_verify_one(i)
                 outstanding.clear()
@@ -1003,11 +1035,21 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                and not ema_is_prior and device_competitive()):
             submit()
         poll(block=False)
+        # Non-hybrid callers still get the host lane WHILE an unmeasured
+        # cold-shape call is in flight: that call may be a minutes-long
+        # first compile (grace budget in poll), and parking every batch
+        # behind it would turn a seized device into a 600 s verification
+        # stall.  Once the shape has completed once, non-hybrid reverts
+        # to trusting the device (with the normal short deadline).
+        grace_hybrid = (not hybrid and ema_is_prior and outstanding
+                        and not msm.shape_completed(outstanding[0][3],
+                                                    outstanding[0][4]))
+        lane_hybrid = hybrid or grace_hybrid
         # host lane: steal one batch from the tail, then re-poll
-        if hybrid and remaining and outstanding:
+        if lane_hybrid and remaining and outstanding:
             host_verify_one(remaining.pop())
         elif outstanding:
-            if hybrid:
+            if lane_hybrid:
                 # Nothing left in the pool: RACE the in-flight chunks —
                 # re-verify their batches on the host (last chunk first,
                 # its results are furthest away), dropping any chunk the
@@ -1015,7 +1057,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 # the math is identical either way.
                 stole = False
                 for ci in range(len(outstanding) - 1, -1, -1):
-                    cid, idxs, _t0, padded_b = outstanding[ci]
+                    cid, idxs, _t0, padded_b, _nl = outstanding[ci]
                     undecided = [i for i in idxs if not decided[i]]
                     if undecided:
                         host_verify_one(undecided[-1])
@@ -1080,6 +1122,7 @@ def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
         pp = np.stack([p] * chunk)
         with msm.DEVICE_CALL_LOCK:
             np.asarray(msm.dispatch_window_sums_many(dd, pp))
+        msm.mark_shape_completed(dd.shape[0], dd.shape[2])
     except Exception:
         return  # warming is an optimization; the scheduler still works
 

@@ -78,23 +78,43 @@ def ensure_compile_cache():
     anyway.  Opt out with ED25519_TPU_JAX_CACHE_DIR=''."""
     if _cache_configured[0]:
         return
-    _cache_configured[0] = True
     import os
 
     d = os.environ.get("ED25519_TPU_JAX_CACHE_DIR")
     if d is None:
         d = os.path.expanduser("~/.cache/ed25519_tpu_jax")
     if not d:
+        _cache_configured[0] = True
         return
     try:
         import jax
 
         if jax.devices()[0].platform == "cpu":
+            _cache_configured[0] = True
             return
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # Latch only after config SUCCEEDS: a transient import/device
+        # failure here must not permanently disable the persistent cache
+        # for the process (the next kernel build retries).
+        _cache_configured[0] = True
     except Exception:
         pass  # cache is an optimization; never fail dispatch over it
+
+# (n_batches, n_lanes) shapes that have COMPLETED at least one device
+# call this process: a call for a shape in this set cannot be sitting in
+# a first compile, so the scheduler holds it to the normal turnaround
+# deadline instead of the minutes-long compile grace budget.
+_shapes_completed = set()
+
+
+def mark_shape_completed(n_batches: int, n_lanes: int) -> None:
+    _shapes_completed.add((int(n_batches), int(n_lanes)))
+
+
+def shape_completed(n_batches: int, n_lanes: int) -> bool:
+    return (int(n_batches), int(n_lanes)) in _shapes_completed
+
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
 

@@ -1,0 +1,86 @@
+"""Human-readable structured serialization with validating deserialize.
+
+The reference derives serde on `Signature` / `VerificationKeyBytes`
+(src/signature.rs:6, src/verification_key.rs:33) and bridges
+`VerificationKey` deserialization through `TryFrom<VerificationKeyBytes>`
+so that *deserializing a validated key validates it*
+(src/verification_key.rs:107-109); `SigningKey` gets a hand-written
+64-byte tuple impl (src/signing_key.rs:31-78).  Those derives serve two
+serde modes: compact binary (bincode — covered here by each type's
+`to_bytes`/`from_bytes`, byte-exact) and human-readable formats (JSON &
+friends) — covered here.
+
+Human-readable convention: every type is a lowercase hex string of its
+compact encoding (64 hex chars for 32-byte types, 128 for signatures and
+signing keys).  `to_json`/`from_json` wrap the hex forms for callers that
+want a self-describing JSON document.  Deserializing a `VerificationKey`
+ALWAYS validates (decompression may fail -> MalformedPublicKey), exactly
+like the reference bridge; `VerificationKeyBytes` stays unvalidated by
+design (L1 validation-deferral invariant, SURVEY.md §1).
+"""
+
+import json
+
+from .signature import Signature
+from .signing_key import SigningKey
+from .verification_key import VerificationKey, VerificationKeyBytes
+
+# type tag (JSON "type" field) -> class; single source for both directions.
+_TYPES = {
+    "signature": Signature,
+    "verification_key_bytes": VerificationKeyBytes,
+    "verification_key": VerificationKey,
+    "signing_key": SigningKey,
+}
+_TAGS = {cls: tag for tag, cls in _TYPES.items()}
+
+
+def to_hex(obj) -> str:
+    """Lowercase hex of the compact encoding (the human-readable serde
+    form).  Accepts any of the four public types."""
+    if type(obj) not in _TAGS:
+        raise TypeError(f"not a serializable ed25519 type: {type(obj)!r}")
+    return obj.to_bytes().hex()
+
+
+def from_hex(cls, s: str):
+    """Parse `cls` from its hex form.  `VerificationKey` is validated
+    (reference deserialize-validates bridge, src/verification_key.rs:107-109)
+    -> raises MalformedPublicKey on a non-point; all types raise
+    InvalidSliceLength on wrong length, ValueError on non-hex."""
+    if cls not in _TAGS:
+        raise TypeError(f"not a serializable ed25519 type: {cls!r}")
+    try:
+        data = bytes.fromhex(s)
+    except (ValueError, TypeError):
+        raise ValueError(f"invalid hex string for {cls.__name__}")
+    # Strict parse: exactly 2 chars/byte (bytes.fromhex tolerates
+    # whitespace — two textually distinct documents must not alias).
+    # Case variation IS accepted on input; output is always lowercase.
+    if len(s) != 2 * len(data):
+        raise ValueError(f"invalid hex string for {cls.__name__}")
+    # SigningKey accepts 32 (seed) or 64 (expanded) byte forms, like its
+    # TryFrom<&[u8]> (src/signing_key.rs:102-116); the rest are fixed-size.
+    return cls.from_bytes(data)
+
+
+def to_json(obj) -> str:
+    """Self-describing JSON document: {"type": tag, "bytes": hex}."""
+    hexed = to_hex(obj)  # raises TypeError for unsupported types
+    return json.dumps({"type": _TAGS[type(obj)], "bytes": hexed})
+
+
+def from_json(s: str):
+    """Inverse of `to_json`; dispatches on the "type" tag and validates
+    where the type validates (VerificationKey)."""
+    doc = json.loads(s)
+    if (
+        not isinstance(doc, dict)
+        or not isinstance(doc.get("type"), str)
+        or not isinstance(doc.get("bytes"), str)
+    ):
+        raise ValueError("expected a {'type','bytes'} JSON object")
+    tag = doc["type"]
+    if tag not in _TYPES:
+        raise ValueError(f"unknown type tag {tag!r}")
+    return from_hex(_TYPES[tag], doc["bytes"])

@@ -95,6 +95,12 @@ class VerificationKey:
             raise MalformedPublicKey()
         return cls(vkb, A.neg())
 
+    @classmethod
+    def from_signing_key(cls, sk) -> "VerificationKey":
+        """Derive from a signing key (reference `From<&SigningKey>`,
+        src/signing_key.rs:23-29)."""
+        return sk.verification_key()
+
     def to_bytes(self) -> bytes:
         return self.A_bytes.to_bytes()
 
@@ -108,6 +114,29 @@ class VerificationKey:
         if isinstance(other, VerificationKey):
             return self.A_bytes == other.A_bytes
         return NotImplemented
+
+    # Total ordering forwards to the byte encoding, exactly like the
+    # reference's Ord/PartialOrd impls (src/verification_key.rs:116-127),
+    # so validated keys can key sorted maps.
+    def __lt__(self, other):
+        if not isinstance(other, VerificationKey):
+            return NotImplemented
+        return self.A_bytes < other.A_bytes
+
+    def __le__(self, other):
+        if not isinstance(other, VerificationKey):
+            return NotImplemented
+        return self.A_bytes <= other.A_bytes
+
+    def __gt__(self, other):
+        if not isinstance(other, VerificationKey):
+            return NotImplemented
+        return other.A_bytes < self.A_bytes
+
+    def __ge__(self, other):
+        if not isinstance(other, VerificationKey):
+            return NotImplemented
+        return other.A_bytes <= self.A_bytes
 
     def __hash__(self):
         return hash(self.A_bytes)

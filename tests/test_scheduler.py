@@ -68,6 +68,10 @@ def warm_kernel_cache():
         digits = np.zeros((nb, limbs.NWINDOWS, n_lanes), dtype=np.int8)
         pts = np.stack([limbs.identity_point_batch(n_lanes)] * nb)
         np.asarray(msm.dispatch_window_sums_many(digits, pts))
+        # Completed ⇒ the scheduler holds these shapes to the normal
+        # deadline (no first-compile grace) — exactly like production
+        # warm_device_shapes.
+        msm.mark_shape_completed(nb, n_lanes)
 
 
 def test_device_error_falls_back_to_host(monkeypatch):
@@ -121,7 +125,10 @@ def test_error_chunk_benches_device_for_the_call(monkeypatch):
 def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     """A stalled device call (tunnel seizure) must miss its deadline, mark
     the device sick, re-verify its batches on the host, abandon the lane,
-    and start the cooldown."""
+    and start the cooldown.  Warmed first: an UNWARMED shape's first call
+    legitimately gets the compile grace budget instead (see
+    test_unwarmed_first_call_gets_compile_grace)."""
+    warm_kernel_cache()
     release = threading.Event()
 
     def stall(digits, pts):
@@ -148,6 +155,40 @@ def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     assert batch._device_cooldown_until[0] > t0  # cooldown armed
     # the sick lane was abandoned: a fresh get() builds a new one
     assert batch._DeviceLane._instance is None
+
+
+def test_unwarmed_first_call_gets_compile_grace(monkeypatch):
+    """hybrid=False with an UNWARMED shape: the first device call may be
+    sitting in a minutes-long kernel compile, so a call that merely
+    exceeds the normal ~2 s turnaround deadline must NOT mark the device
+    sick / stick the lane (round-2 advisor finding).  Seizure detection
+    for warmed shapes is test_deadline_miss_abandons_lane_and_sets_cooldown."""
+    warm_kernel_cache()  # compile the real kernel so verdict math is fast
+    monkeypatch.setattr(msm, "_shapes_completed", set())  # …but look cold
+    real_dispatch = msm.dispatch_window_sums_many
+    calls = []
+
+    def slow_first_call(digits, pts):
+        calls.append(digits.shape[0])
+        time.sleep(3.0)  # longer than the normal 2 s deadline floor
+        return real_dispatch(digits, pts)
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", slow_first_call)
+    vs = make_verifiers(3, bad={1})
+    t0 = time.monotonic()
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never")
+    assert verdicts == expected(3, bad={1})
+    stats = batch.last_run_stats
+    assert len(calls) >= 1  # the device was actually exercised
+    # slow-but-compiling is NOT sick: no cooldown, lane kept
+    assert not stats["device_sick"]
+    assert not batch.device_lane_stuck()
+    assert batch._device_cooldown_until[0] <= t0
+    # …and the grace window doesn't park the caller behind the slow
+    # call: the host lane covers the pool meanwhile (grace-hybrid), so
+    # total wall stays ~one slow call, not batches × slow calls
+    assert time.monotonic() - t0 < 10.0
 
 
 def test_cooldown_skips_device_entirely(monkeypatch):

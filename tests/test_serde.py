@@ -1,0 +1,118 @@
+"""Human-readable serialization + ordering parity.
+
+Covers the reference serde surface the compact (bincode-analog) byte
+round-trips don't: hex/JSON forms with deserialize-time validation for
+`VerificationKey` (reference src/verification_key.rs:107-109) and the
+byte-encoding total order on validated keys (src/verification_key.rs:116-127).
+"""
+
+import random
+
+import pytest
+
+from ed25519_consensus_tpu import (
+    MalformedPublicKey,
+    Signature,
+    SigningKey,
+    VerificationKey,
+    VerificationKeyBytes,
+    serde,
+)
+
+
+def _fresh(seed=7):
+    rng = random.Random(seed)
+    sk = SigningKey.new(rng)
+    sig = sk.sign(b"serde round trip")
+    return sk, sk.verification_key(), sig
+
+
+def test_hex_round_trips_all_types():
+    sk, vk, sig = _fresh()
+    assert serde.from_hex(Signature, serde.to_hex(sig)) == sig
+    assert (
+        serde.from_hex(VerificationKeyBytes, serde.to_hex(vk.A_bytes))
+        == vk.A_bytes
+    )
+    assert serde.from_hex(VerificationKey, serde.to_hex(vk)) == vk
+    sk2 = serde.from_hex(SigningKey, serde.to_hex(sk))
+    assert sk2.to_bytes() == sk.to_bytes()  # 64-byte tuple form, byte-exact
+
+
+def test_signing_key_hex_seed_form():
+    # SigningKey deserialization accepts the 32-byte seed form too,
+    # mirroring TryFrom<&[u8]> length dispatch (src/signing_key.rs:102-116).
+    seed = bytes(range(32))
+    sk = serde.from_hex(SigningKey, seed.hex())
+    assert sk.to_bytes() == SigningKey.from_seed(seed).to_bytes()
+
+
+def test_json_round_trips_and_dispatch():
+    sk, vk, sig = _fresh()
+    for obj in (sig, vk.A_bytes, vk):
+        back = serde.from_json(serde.to_json(obj))
+        assert type(back) is type(obj) and back == obj
+    back = serde.from_json(serde.to_json(sk))
+    assert back.to_bytes() == sk.to_bytes()
+
+
+def test_verification_key_deserialize_validates():
+    # 2 is not the y of any curve point: VerificationKeyBytes accepts it
+    # (unvalidated refinement type), VerificationKey must reject at
+    # deserialize time — the serde bridge contract.
+    bad = (2).to_bytes(32, "little")
+    assert serde.from_hex(VerificationKeyBytes, bad.hex()) is not None
+    with pytest.raises(MalformedPublicKey):
+        serde.from_hex(VerificationKey, bad.hex())
+    with pytest.raises(MalformedPublicKey):
+        serde.from_json(
+            '{"type": "verification_key", "bytes": "%s"}' % bad.hex()
+        )
+
+
+def test_serde_error_paths():
+    with pytest.raises(ValueError):
+        serde.from_hex(Signature, "zz")
+    # whitespace-laced hex must not alias the canonical document
+    _, vk, _ = _fresh()
+    spaced = " " + serde.to_hex(vk.A_bytes)
+    with pytest.raises(ValueError):
+        serde.from_hex(VerificationKeyBytes, spaced)
+    # …but pure case variation is accepted on input
+    upper = serde.to_hex(vk.A_bytes).upper()
+    assert serde.from_hex(VerificationKeyBytes, upper) == vk.A_bytes
+    with pytest.raises(TypeError):
+        serde.to_hex(b"raw bytes are not a typed object")
+    with pytest.raises(TypeError):
+        serde.to_json(b"raw bytes are not a typed object")
+    with pytest.raises(ValueError):
+        serde.from_json('{"type": "nope", "bytes": ""}')
+    with pytest.raises(ValueError):
+        serde.from_json('[1, 2, 3]')
+    # non-string fields must surface as the documented ValueError, not
+    # a TypeError escaping from bytes.fromhex
+    with pytest.raises(ValueError):
+        serde.from_json('{"type": "signature", "bytes": 123}')
+    with pytest.raises(ValueError):
+        serde.from_json('{"type": 3, "bytes": ""}')
+
+
+def test_verification_key_total_order_forwards_to_bytes():
+    rng = random.Random(11)
+    vks = [SigningKey.new(rng).verification_key() for _ in range(12)]
+    by_key = sorted(vks)
+    by_enc = sorted(vks, key=lambda vk: vk.to_bytes())
+    assert [vk.to_bytes() for vk in by_key] == [
+        vk.to_bytes() for vk in by_enc
+    ]
+    a, b = by_key[0], by_key[-1]
+    assert a < b and a <= b and b > a and b >= a and a != b
+    assert not (a < a) and a <= a and a >= a
+    # cross-type comparisons stay undefined, like the reference's typed Ord
+    with pytest.raises(TypeError):
+        _ = a < a.A_bytes
+
+
+def test_from_signing_key_sugar():
+    sk, vk, _ = _fresh()
+    assert VerificationKey.from_signing_key(sk) == vk
