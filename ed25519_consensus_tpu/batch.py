@@ -101,13 +101,15 @@ _SHIFT_CACHE_MAX = 1 << 16
 
 
 def _shift128_for_key(vk_bytes: bytes, A_row) -> "object":
-    """Cached [2^128]A; `A_row` is the key's raw 128-byte coordinate row
-    (only touched on a cache miss)."""
+    """Cached AFFINE [2^128]A; `A_row` is the key's raw 128-byte
+    coordinate row (only touched on a cache miss).  Normalizing at cache
+    time (one field inversion, amortized across the key's whole stream)
+    is what lets device staging ship X‖Y-only affine operands."""
     sp = _shift128_cache.get(vk_bytes)
     if sp is None:
         from . import native
 
-        sp = edwards.shift128(native.point_from_raw(A_row))
+        sp = edwards.shift128(native.point_from_raw(A_row)).to_affine()
         if len(_shift128_cache) >= _SHIFT_CACHE_MAX:
             _shift128_cache.pop(next(iter(_shift128_cache)))
         _shift128_cache[vk_bytes] = sp
@@ -184,7 +186,10 @@ class StagedBatch:
 
     def device_operands(self, pad_fn):
         """Build the padded device operands — signed digit planes
-        (NWINDOWS, N) int8 and point limbs (4, NLIMBS, N) int16:
+        (NWINDOWS, N) int8 and AFFINE point limbs (2, NLIMBS, N) int16
+        (X‖Y only; T = X·Y and Z = 1 are reconstructed on-device, halving
+        the point H2D bytes — every staged point is affine: decompression
+        emits Z = 1 rows and the shift-point cache normalizes):
         coefficients split into 128-bit chunks against their shift
         points, blinder digits and point limbs packed vectorized from
         the raw buffers."""
@@ -211,15 +216,15 @@ class StagedBatch:
                 self.n_sigs, 16
             )
             digits[:, n_head:n] = limbs.pack_u128_windows(zb)
-        pts = limbs.identity_point_batch(N)
-        pts[..., :n_coeff] = limbs.pack_points_from_raw(
+        pts = limbs.identity_affine_batch(N)
+        pts[..., :n_coeff] = limbs.pack_points_affine_from_raw(
             self.raw_points[:n_coeff]
         )
         if hi_p:
-            pts[..., n_coeff:n_head] = limbs.pack_point_batch(
+            pts[..., n_coeff:n_head] = limbs.pack_point_affine_batch(
                 hi_p
             ).astype(np.int16)
-        pts[..., n_head:n] = limbs.pack_points_from_raw(
+        pts[..., n_head:n] = limbs.pack_points_affine_from_raw(
             self.raw_points[n_coeff:]
         )
         return digits, pts
@@ -885,7 +890,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             digits = np.concatenate(
                 [digits, np.zeros((nb,) + digits.shape[1:], np.int8)]
             )
-            ident = limbs.identity_point_batch(pts.shape[-1])
+            mk_ident = (limbs.identity_affine_batch if pts.shape[1] == 2
+                        else limbs.identity_point_batch)
+            ident = mk_ident(pts.shape[-1])
             pts = np.concatenate(
                 [pts, np.stack([ident] * nb).astype(pts.dtype)]
             )

@@ -58,10 +58,18 @@ def _build() -> str:
 
 def load():
     """Return the ctypes library, building it if needed; None if
-    unavailable (no toolchain, load failure, or failed self-check)."""
+    unavailable (no toolchain, load failure, failed self-check, or
+    disabled via ED25519_TPU_DISABLE_NATIVE=1 — every caller has an
+    exact-Python fallback, so disabling trades speed for nothing)."""
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
+    if os.environ.get("ED25519_TPU_DISABLE_NATIVE", "").lower() in (
+        "1", "true", "yes"
+    ):
+        # explicit opt-outs only: "0"/"false" must NOT disable
+        _lib_failed = True
+        return None
     try:
         lib = ctypes.CDLL(_build())
         lib.zip215_decompress_batch.argtypes = [

@@ -61,6 +61,17 @@ class Point:
         H = (-A - B) % P
         return Point(E * F % P, G * H % P, F * G % P, E * H % P)
 
+    def to_affine(self) -> "Point":
+        """The same projective class with Z = 1 (one field inversion).
+        Affine points ship to the device as X‖Y only — T = X·Y and Z = 1
+        are reconstructed on-device, halving the point H2D bytes."""
+        from .field import P, inv
+
+        zi = inv(self.Z % P)
+        x = self.X * zi % P
+        y = self.Y * zi % P
+        return Point(x, y, 1, x * y % P)
+
     def neg(self) -> "Point":
         return Point((-self.X) % P, self.Y, self.Z, (-self.T) % P)
 
@@ -237,10 +248,11 @@ _BASEPOINT_SHIFT128 = None
 
 
 def basepoint_shift128() -> Point:
-    """[2^128]B, precomputed once for the basepoint coefficient split."""
+    """[2^128]B, precomputed once for the basepoint coefficient split.
+    Affine (Z = 1) so it can ship in the X‖Y device wire format."""
     global _BASEPOINT_SHIFT128
     if _BASEPOINT_SHIFT128 is None:
-        _BASEPOINT_SHIFT128 = shift128(BASEPOINT)
+        _BASEPOINT_SHIFT128 = shift128(BASEPOINT).to_affine()
     return _BASEPOINT_SHIFT128
 
 

@@ -170,3 +170,39 @@ def identity_point_batch(n: int) -> np.ndarray:
     out[1, 0, :] = 1
     out[2, 0, :] = 1
     return out
+
+
+def pack_points_affine_from_raw(raw: np.ndarray) -> np.ndarray:
+    """Affine wire format: (T, 128) uint8 raw rows (Z MUST be 1 — the
+    decompression output guarantees it) → (2, NLIMBS, T) int16 of X‖Y
+    limbs only.  T = X·Y and Z = 1 are reconstructed on-device
+    (ops/msm.py expand stage), halving the point H2D bytes."""
+    n = raw.shape[0]
+    coords = raw[:, :64].reshape(n, 2, 32)
+    bits = np.unpackbits(coords, axis=2, bitorder="little")  # (n, 2, 256)
+    bits = np.concatenate(
+        [bits, np.zeros((n, 2, NLIMBS * LIMB_BITS - 256), np.uint8)],
+        axis=2,
+    )
+    limbs13 = bits.reshape(n, 2, NLIMBS, LIMB_BITS).astype(np.int16)
+    vals = limbs13 @ _LIMB_WEIGHTS.astype(np.int16)  # (n, 2, NLIMBS)
+    return np.ascontiguousarray(np.moveaxis(vals, 0, 2))
+
+
+def pack_point_affine_batch(points) -> np.ndarray:
+    """Affine wire format from host Points; callers must pass Z = 1
+    points (see edwards.Point.to_affine)."""
+    from .field import P
+
+    for pt in points:
+        if pt.Z % P != 1:
+            raise ValueError("affine packing requires Z = 1 points")
+    coords = [[pt.X % P for pt in points], [pt.Y % P for pt in points]]
+    return np.stack([pack_field_batch(c) for c in coords])
+
+
+def identity_affine_batch(n: int) -> np.ndarray:
+    """(2, NLIMBS, n) int16 affine-format identity batch (x = 0, y = 1)."""
+    out = np.zeros((2, NLIMBS, n), dtype=np.int16)
+    out[1, 0, :] = 1
+    return out

@@ -324,22 +324,60 @@ def preferred_pad(n: int) -> int:
     return _pad_lanes(n)
 
 
+def expand_affine_points(points):
+    """On-device expansion of the affine wire format: (B, 2, NLIMBS, N)
+    int16 X‖Y limbs → (B, 4, NLIMBS, N) int16 extended coords with Z = 1
+    and T = X·Y (one balanced-limb field mul — the result limbs stay in
+    the U bound, so the int16 cast is exact; see jnp_field closure
+    proofs).  Runs INSIDE the dispatch jit: the wire carries half the
+    point bytes and the MXU-free mul is noise next to the kernel."""
+    import jax.numpy as jnp
+
+    from . import jnp_field
+
+    X = jnp.moveaxis(points[:, 0].astype(jnp.int32), 1, 0)  # (NLIMBS,B,N)
+    Y = jnp.moveaxis(points[:, 1].astype(jnp.int32), 1, 0)
+    T = jnp_field.mul(X, Y)
+    Z = jnp.concatenate(
+        [jnp.ones_like(X[:1]), jnp.zeros_like(X[1:])], axis=0
+    )
+    pts4 = jnp.stack([X, Y, Z, T])  # (4, NLIMBS, B, N)
+    return jnp.moveaxis(pts4, 2, 0).astype(jnp.int16)
+
+
+def expand_affine_points_single(points):
+    """Unbatched on-device affine expansion: (2, NLIMBS, N) int16 →
+    (4, NLIMBS, N) int16 (Z = 1, T = X·Y).  One copy of the math: the
+    batched form with a singleton batch axis."""
+    return expand_affine_points(points[None])[0]
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_kernel_many(n_batches: int, n_lanes: int,
-                          nwin: int = NWINDOWS):
+                          nwin: int = NWINDOWS, affine: bool = False):
     """vmap of the XLA scan kernel over a leading batch axis: B independent
     verification batches in ONE device call (the per-call tunnel round-trip
-    dominates on remote-attached devices)."""
+    dominates on remote-attached devices).  With `affine`, points arrive
+    as (B, 2, NLIMBS, N) and are expanded on-device."""
     import jax
 
     kernel = _compiled_kernel.__wrapped__(n_lanes, nwin)
-    return jax.jit(jax.vmap(kernel))
+    vk = jax.vmap(kernel)
+    if not affine:
+        return jax.jit(vk)
+
+    def f(digits, pts2):
+        return vk(digits, expand_affine_points(pts2))
+
+    return jax.jit(f)
 
 
 def dispatch_window_sums_many(digits, points):
     """One device call for B stacked batches: digits (B, NWINDOWS, N),
-    points (B, 4, NLIMBS, N) numpy → (B, 4, NLIMBS, NWINDOWS) device array
-    with its D2H copy in flight."""
+    points (B, 4, NLIMBS, N) legacy extended format OR (B, 2, NLIMBS, N)
+    affine X‖Y format (auto-detected; T/Z reconstructed on-device) →
+    (B, 4, NLIMBS, NWINDOWS) device array with its D2H copy in flight."""
+    affine = points.shape[1] == 2
     with DEVICE_CALL_LOCK:
         if _use_pallas():
             from . import pallas_msm
@@ -347,7 +385,8 @@ def dispatch_window_sums_many(digits, points):
             out = pallas_msm.pallas_window_sums_many(digits, points)
         else:
             out = _compiled_kernel_many(digits.shape[0], digits.shape[2],
-                                        digits.shape[1])(digits, points)
+                                        digits.shape[1],
+                                        affine=affine)(digits, points)
         try:
             out.copy_to_host_async()
         except AttributeError:

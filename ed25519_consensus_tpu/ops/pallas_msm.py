@@ -103,6 +103,102 @@ def _fmul(a, b):
 _D2_LIMBS = [int(v) for v in limbs_mod.int_to_limbs(D2 % P)]
 
 
+# -- field ops over WHOLE (NLIMBS, S, L) int32 arrays ("rolled" body) ------
+# Same balanced-limb semantics and carry-step counts as the list-of-tiles
+# ops above (and as jnp_field.py — its closure proofs apply verbatim); the
+# difference is purely trace size: one jnp op covers all 20 limbs, and the
+# schoolbook product is 20 shifted multiply-accumulates instead of 400
+# per-limb-pair products.  This is what turns the kernel's traced body
+# from ~400k equations (~3 min of Python tracing per shape, never cached)
+# into a few thousand.
+
+
+def _carry_a(x, steps, fold=True):
+    import jax.numpy as jnp
+
+    for _ in range(steps):
+        c = (x + _HALF) >> LIMB_BITS
+        r = x - (c << LIMB_BITS)
+        if fold:
+            shifted = jnp.concatenate([c[-1:] * FOLD, c[:-1]], axis=0)
+        else:
+            shifted = jnp.concatenate(
+                [jnp.zeros_like(c[:1]), c[:-1]], axis=0
+            )
+        x = r + shifted
+    return x
+
+
+def _fadd_a(a, b):
+    return _carry_a(a + b, 1)
+
+
+def _fsub_a(a, b):
+    return _carry_a(a - b, 1)
+
+
+def _fmul_small_a(a, k):
+    return _carry_a(a * k, 1)
+
+
+def _fmul_a(a, b):
+    """a · b (mod p): schoolbook via 20 statically-shifted mul-accumulates
+    (wide[k] = Σ_i a_i·b_{k-i}; the shift is a static roll, so every op is
+    Mosaic-friendly).  Columns ≤ 20·8191² < 2^31 — int32-safe, identical
+    bounds to jnp_field.mul."""
+    import jax.numpy as jnp
+
+    trailing = b.shape[1:]
+    ZW = 2 * NLIMBS + 1  # 39 product columns + 2 wide-carry columns
+    buf = jnp.concatenate(
+        [b, jnp.zeros((ZW - NLIMBS,) + trailing, jnp.int32)], axis=0
+    )
+    wide = jnp.zeros((ZW,) + trailing, jnp.int32)
+    for i in range(NLIMBS):
+        wide = wide + a[i][None] * buf
+        # roll down one limb: buf_i[k] = b[k-i]; slot 40 stays zero for
+        # all 20 iterations, so nothing wraps into the live columns
+        buf = jnp.concatenate([buf[-1:], buf[:-1]], axis=0)
+    wide = _carry_a(wide, 2, fold=False)
+    low = wide[:NLIMBS] + wide[NLIMBS: 2 * NLIMBS] * FOLD
+    esc = jnp.concatenate(
+        [wide[2 * NLIMBS:] * (FOLD * FOLD),
+         jnp.zeros((NLIMBS - 1,) + trailing, jnp.int32)],
+        axis=0,
+    )
+    return _carry_a(low + esc, 5)
+
+
+def _padd_a(p, q):
+    """Complete unified addition (add-2008-hwcd-3, a=-1) on (4, NLIMBS,
+    S, L) arrays — the array-representation twin of `_padd`."""
+    import jax.numpy as jnp
+
+    X1, Y1, Z1, T1 = p[0], p[1], p[2], p[3]
+    X2, Y2, Z2, T2 = q[0], q[1], q[2], q[3]
+    A = _fmul_a(_fsub_a(Y1, X1), _fsub_a(Y2, X2))
+    B = _fmul_a(_fadd_a(Y1, X1), _fadd_a(Y2, X2))
+    # Scalar fills, not a materialized const array (pallas kernels must
+    # not capture traced constants) — at the FULL tile shape: feeding
+    # _fmul_a a (NLIMBS, 1, 1) operand crashes the Mosaic compiler on
+    # the sub-tile broadcast (probed on v5e).
+    d2 = jnp.stack([
+        jnp.full(T1.shape[1:], v, jnp.int32) for v in _D2_LIMBS
+    ])
+    C = _fmul_a(_fmul_a(d2, T1), T2)
+    Dv = _fmul_small_a(_fmul_a(Z1, Z2), 2)
+    E = _fsub_a(B, A)
+    Fv = _fsub_a(Dv, C)
+    G = _fadd_a(Dv, C)
+    H = _fadd_a(B, A)
+    return jnp.stack([
+        _fmul_a(E, Fv),
+        _fmul_a(G, H),
+        _fmul_a(Fv, G),
+        _fmul_a(E, H),
+    ])
+
+
 def _padd(p, q):
     """Complete unified addition (add-2008-hwcd-3, a=-1) on 4×NLIMBS limb
     lists — same formula as jnp_edwards.point_add."""
@@ -124,6 +220,135 @@ def _padd(p, q):
         _fmul(G, H),
         _fmul(Fv, G),
         _fmul(E, H),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
+                                   nwin: int = NWINDOWS,
+                                   interpret: bool = False,
+                                   tile=(SUBLANES, LANES),
+                                   tbl_dtype="int16",
+                                   win_chunk: int = 1,
+                                   unroll_windows: bool = False):
+    """The `rolled` kernel body: identical math and data layout to the
+    unrolled kernel below, but field elements are whole (NLIMBS, S, L)
+    arrays and the select/window loops are `fori_loop`s with dynamic ref
+    indices (the table-build loop already relied on those), so the traced
+    body is a few thousand equations instead of ~400k — cold trace drops
+    from minutes to seconds per shape.  Parity is pinned by the same
+    interpret-mode tests and the on-hardware 196-matrix as the unrolled
+    body.
+
+    `unroll_windows` is the `hybrid` style: keep the array-representation
+    field math (small trace) but statically unroll the per-step window
+    and table-select loops — sequential `fori_loop`s cost Mosaic its
+    cross-window instruction pipelining (measured ~3-5× per-block on
+    v5e), while the unrolled schedule recovers it at ~5× the (still
+    small) trace."""
+    from .msm import ensure_compile_cache
+
+    ensure_compile_cache()
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, Ln = tile
+    fS = min(FOLD_SUBLANES, S)
+    tdt = jnp.int16 if tbl_dtype == "int16" else jnp.int32
+    W = win_chunk
+    assert nwin % W == 0
+
+    def kernel(dig_ref, pts_ref, out_ref, tbl_ref):
+        w = pl.program_id(2)
+
+        # --- table build once per (batch, block), at the first window ----
+        @pl.when(w == 0)
+        def _build_table():
+            pt = pts_ref[0, :, :, 0].astype(jnp.int32)  # (4, NLIMBS, S, L)
+            zero_el = jnp.zeros((NLIMBS, S, Ln), jnp.int32)
+            one_el = jnp.concatenate(
+                [jnp.ones((1, S, Ln), jnp.int32),
+                 jnp.zeros((NLIMBS - 1, S, Ln), jnp.int32)],
+                axis=0,
+            )
+            tbl_ref[0] = jnp.stack(
+                [zero_el, one_el, one_el, zero_el]
+            ).astype(tdt)
+            tbl_ref[1] = pt.astype(tdt)
+
+            def table_body(k, _):
+                prev = tbl_ref[k - 1].astype(jnp.int32)
+                tbl_ref[k] = _padd_a(prev, pt).astype(tdt)
+                return 0
+
+            jax.lax.fori_loop(2, 9, table_body, 0)
+
+        # --- this step's windows: select + in-block lane fold ------------
+        def win_body(wi, _):
+            d = dig_ref[0, wi, 0].astype(jnp.int32)  # (S, Ln)
+            mag = jnp.abs(d)
+
+            if unroll_windows:
+                sel = jnp.zeros((4, NLIMBS, S, Ln), jnp.int32)
+                for k in range(9):
+                    mask = (mag == k).astype(jnp.int32)
+                    sel = sel + mask[None, None] * tbl_ref[k].astype(
+                        jnp.int32)
+            else:
+                def sel_body(k, sel):
+                    mask = (mag == k).astype(jnp.int32)
+                    return sel + mask[None, None] * tbl_ref[k].astype(
+                        jnp.int32)
+
+                sel = jax.lax.fori_loop(
+                    0, 9, sel_body,
+                    jnp.zeros((4, NLIMBS, S, Ln), jnp.int32),
+                )
+            # negative digits: negate X and T (free in balanced limbs)
+            sgn = jnp.where(d < 0, jnp.int32(-1), jnp.int32(1))
+            one = jnp.ones_like(sgn)
+            sel = sel * jnp.stack([sgn, one, one, sgn])[:, None]
+            # fold the sublane rows down by halving point-adds
+            s = S
+            while s > fS:
+                half = s // 2
+                sel = _padd_a(sel[:, :, :half], sel[:, :, half:])
+                s = half
+            out_ref[0, 0, wi] = sel.astype(jnp.int16)
+            return 0
+
+        if unroll_windows:
+            for wi in range(W):
+                win_body(wi, 0)
+        else:
+            jax.lax.fori_loop(0, W, win_body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_batches, n_blocks, nwin // W),
+        in_specs=[
+            pl.BlockSpec(
+                (1, W, 1, S, Ln), lambda b, i, w: (b, w, i, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 4, NLIMBS, 1, S, Ln),
+                lambda b, i, w: (b, 0, 0, i, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, W, 4, NLIMBS, fS, Ln),
+            lambda b, i, w: (b, i, w, 0, 0, 0, 0),
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_batches, n_blocks, nwin, 4, NLIMBS, fS, Ln),
+            jnp.int16,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((9, 4, NLIMBS, S, Ln), tdt)
+        ],
+        interpret=interpret,
     )
 
 
@@ -263,10 +488,35 @@ def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
     )
 
 
+_BODY_STYLES = ("rolled", "hybrid", "unrolled")
+
+
+def _body_style() -> str:
+    """Kernel body selection (ED25519_TPU_PALLAS_BODY overrides):
+
+    * `rolled` (DEFAULT): everything in fori_loops — ~5 s of trace and
+      the only body whose Mosaic compile never failed on the tunneled
+      v5e (r3 lab, bench_artifacts/kernel_body_ab_r3.txt): ~50 s true
+      cold start at one block, and steady-state per-batch wall within
+      session noise of the others (the link, not the kernel, dominates
+      on this node).
+    * `hybrid`: array-rep field math + statically unrolled windows —
+      tens of seconds of trace; needs win_chunk ≤ 3 to stay under the
+      remote compile helper's program-size failure threshold at B = 8.
+    * `unrolled`: the round-2 list-of-tiles body — minutes of trace,
+      kept as an A/B fallback; its B = 8 executable no longer compiles
+      through the r3 helper at all."""
+    import os
+
+    v = os.environ.get("ED25519_TPU_PALLAS_BODY", "rolled").lower()
+    return v if v in _BODY_STYLES else "rolled"
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
                        interpret: bool = False, tile=(SUBLANES, LANES),
-                       tbl_dtype="int16", win_chunk: int = 1):
+                       tbl_dtype="int16", win_chunk: int = 1,
+                       body: str | None = None, affine: bool = False):
     """ONE jitted function for the whole device step: Pallas partial-sum
     kernel + XLA fold of the per-block partials, so a multi-batch
     verification is a single tunnel call.
@@ -281,13 +531,25 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     group = S * Ln
     assert n_lanes % group == 0
     n_blocks = n_lanes // group
-    kernel = _compiled_pallas_kernel(n_batches, n_blocks, nwin,
-                                     interpret=interpret, tile=tile,
-                                     tbl_dtype=tbl_dtype,
-                                     win_chunk=win_chunk)
+    style = body or _body_style()
+    if style == "unrolled":
+        kernel = _compiled_pallas_kernel(n_batches, n_blocks, nwin,
+                                         interpret=interpret, tile=tile,
+                                         tbl_dtype=tbl_dtype,
+                                         win_chunk=win_chunk)
+    else:
+        kernel = _compiled_pallas_kernel_rolled(
+            n_batches, n_blocks, nwin, interpret=interpret, tile=tile,
+            tbl_dtype=tbl_dtype, win_chunk=win_chunk,
+            unroll_windows=style == "hybrid",
+        )
     fS = min(FOLD_SUBLANES, S)
 
     def pipeline(digits, points):
+        if affine:
+            from .msm import expand_affine_points
+
+            points = expand_affine_points(points)
         dig = digits.reshape(n_batches, nwin, n_blocks, S, Ln)
         pts = points.reshape(
             n_batches, 4, NLIMBS, n_blocks, S, Ln
@@ -353,16 +615,21 @@ def _auto_win_chunk(nwin: int) -> int:
 
 def pallas_window_sums_many(digits, points, interpret: bool = False,
                             tile=(SUBLANES, LANES), tbl_dtype="int16",
-                            win_chunk: int | None = None):
+                            win_chunk: int | None = None,
+                            body: str | None = None):
     """Batched dispatch: digits (B, nwin, N) int8, points (B, 4, NLIMBS, N)
     int16 numpy arrays → (B, 4, NLIMBS, nwin) device array, one device
     call."""
     B, nwin, N = digits.shape
     if win_chunk is None:
         win_chunk = _auto_win_chunk(nwin)
+    if body is None:
+        body = _body_style()  # resolved here so the env is re-read per call
     return _compiled_pipeline(B, N, nwin, interpret=interpret, tile=tile,
                               tbl_dtype=tbl_dtype,
-                              win_chunk=win_chunk)(digits, points)
+                              win_chunk=win_chunk,
+                              body=body,
+                              affine=points.shape[1] == 2)(digits, points)
 
 
 def pallas_window_sums(digits, points, interpret: bool = False,

@@ -28,12 +28,13 @@ from . import mesh as mesh_lib
 
 @functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
-                             nwin: int):
+                             nwin: int, affine: bool = False):
     """jit a shard_map'd MSM over a 1-D batch mesh.
 
-    Input shapes (global): digits (nwin, N), points (4, NLIMBS, N) with
-    N = n_devices * lanes_per_device; output: replicated
-    (4, NLIMBS, nwin) window sums."""
+    Input shapes (global): digits (nwin, N), points (4, NLIMBS, N) —
+    or, with `affine`, (2, NLIMBS, N) X‖Y limbs expanded per-shard
+    on-device — with N = n_devices * lanes_per_device; output:
+    replicated (4, NLIMBS, nwin) window sums."""
     msm_lib.ensure_compile_cache()
     import jax
     from jax.sharding import PartitionSpec as P
@@ -53,7 +54,9 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
     )  # un-jitted builder result is already a jit fn; call inside shard_map
 
     def shard_fn(digits, points):
-        # Per-device shard: (nwin, N/D), (4, NLIMBS, N/D)
+        # Per-device shard: (nwin, N/D), (4|2, NLIMBS, N/D)
+        if affine:
+            points = msm_lib.expand_affine_points_single(points)
         part = local_kernel(digits, points)  # (4, NLIMBS, nwin)
         # ICI all-reduce in the Edwards group: gather the D partial window
         # sums and fold them with the complete addition law (vectorized
@@ -89,9 +92,11 @@ def _shard_pad(n: int, n_devices: int) -> int:
 
 def sharded_window_sums(digits, pts, n_devices: int):
     """Dispatch pre-packed operands over the mesh; returns the replicated
-    (4, NLIMBS, nwin) window sums as a device array."""
+    (4, NLIMBS, nwin) window sums as a device array.  Points in the
+    legacy (4, NLIMBS, N) or affine (2, NLIMBS, N) wire format."""
     kernel, _ = _compiled_sharded_kernel(
-        n_devices, digits.shape[1] // n_devices, digits.shape[0]
+        n_devices, digits.shape[1] // n_devices, digits.shape[0],
+        affine=pts.shape[0] == 2,
     )
     return kernel(digits, pts)
 

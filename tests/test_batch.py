@@ -145,6 +145,24 @@ def test_verify_many_edge_shapes():
         [True, True, False]
 
 
+def test_challenge_int_normalizes_both_map_representations():
+    """The public signatures-map invariant: challenges are ints (queue)
+    or 32-byte buffers (queue_bulk); `challenge_int` maps both to the
+    same int."""
+    rng = random.Random(31)
+    sk = SigningKey.new(rng)
+    msg = b"challenge-int"
+    entry = (sk.verification_key_bytes(), sk.sign(msg), msg)
+    a, b = batch.Verifier(), batch.Verifier()
+    a.queue(entry)
+    b.queue_bulk([entry])
+    (ka, _), = next(iter(a.signatures.values()))
+    (kb, _), = next(iter(b.signatures.values()))
+    assert type(ka) is int
+    assert batch.challenge_int(ka) == ka
+    assert batch.challenge_int(kb) == ka  # bytes branch, same scalar
+
+
 def test_queue_bulk_matches_queue():
     """queue_bulk (native bulk challenge hashing) must build EXACTLY the
     same coalescing map as per-item queue — same keys, same challenge

@@ -70,16 +70,16 @@ def test_multiblock_interpret_kernel_parity():
     """Run the ACTUAL Pallas kernel in interpret mode across MULTIPLE grid
     blocks and pin it against the exact host MSM — covers the in-kernel
     table build, signed-digit select, cross-block fold, and
-    block-boundary/identity padding.
+    block-boundary/identity padding, for small AND full-width (128-bit)
+    digit planes.
 
-    Infrastructure note: interpret=True lowers to plain XLA ops, but
-    compiling the ~80k-op unrolled body on this repo's 1-core build host
-    takes 10-25 minutes on the TRUE cpu backend (measured; it is compile
-    time, not a hang).  The case therefore runs in a clean subprocess on
-    whatever accelerator is attached (remote compile ~1-2 min) and SKIPS
-    on cpu-only hosts — where Mosaic coverage comes from the committed
-    hardware gate artifact (tools/check_pallas_parity.py,
-    bench_artifacts/pallas_parity_r2.txt)."""
+    Infrastructure note: interpret=True lowers to plain XLA ops.  The
+    rolled kernel body traces/compiles in ~1 min even on the true cpu
+    backend, so cpu-only hosts get real coverage; the legacy unrolled
+    body (~80k-op graph; 10-25 min cpu compile, measured) is additionally
+    pinned when an accelerator is attached (remote compile ~1-2 min).
+    Runs in a clean subprocess so the backend choice can differ from the
+    suite's forced-cpu config."""
     import os
     import subprocess
     import sys

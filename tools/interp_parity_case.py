@@ -29,10 +29,11 @@ def main():
     import jax
 
     backend = jax.devices()[0].platform
-    if backend == "cpu":
-        print("INTERP_PARITY cpu SKIP")  # compile is 10-25 min here
-        sys.stdout.flush()
-        os._exit(0)
+    # Which kernel bodies to pin: the rolled body's interpret graph
+    # compiles in ~1 min even on the true cpu backend, so cpu-only hosts
+    # get real coverage now; the legacy unrolled body stays
+    # accelerator-only (its ~80k-op graph is a 10-25 min cpu compile).
+    bodies = ("rolled",) if backend == "cpu" else ("rolled", "unrolled")
     rng = random.Random(0x1417)
     tile = (1, 128)
     group = tile[0] * tile[1]
@@ -50,15 +51,34 @@ def main():
         sc, pts, n_lanes=pallas_msm.pad_lanes(n, group)
     )
     digits = digits[-2:]  # scalars < 16: higher MSB-first planes all zero
-    out = np.asarray(
-        pallas_msm.pallas_window_sums_many(
-            digits[None], packed[None], interpret=True, tile=tile
-        )
-    )
-    got = msm.combine_window_sums(out)
     want = edwards.multiscalar_mul(sc, pts)
-    print(f"INTERP_PARITY {backend} "
-          f"{'MATCH' if got == want else 'MISMATCH'}")
+    # 128-bit scalars cover every digit plane (the widest the kernel ever
+    # sees: full-width coefficients arrive pre-split by msm.split_terms)
+    sc_wide = [rng.randrange(1 << 128) for _ in range(n)]
+    sc_wide[0] = (1 << 128) - 1
+    dig_w, packed_w = msm.pack_msm_operands(
+        sc_wide, pts, n_lanes=pallas_msm.pad_lanes(n, group)
+    )
+    want_wide = edwards.multiscalar_mul(sc_wide, pts)
+    verdicts = []
+    for body in bodies:
+        for dig, pk, want_pt, label in (
+            (digits, packed, want, "small"),
+            (dig_w, packed_w, want_wide, "wide"),
+        ):
+            out = np.asarray(
+                pallas_msm.pallas_window_sums_many(
+                    dig[None], pk[None], interpret=True, tile=tile,
+                    body=body,
+                )
+            )
+            got = msm.combine_window_sums(out)
+            verdicts.append(
+                f"{body}/{label}:"
+                f"{'MATCH' if got == want_pt else 'MISMATCH'}"
+            )
+    verdict = " ".join(verdicts)
+    print(f"INTERP_PARITY {backend} {verdict}")
     sys.stdout.flush()
     os._exit(0)
 

@@ -154,6 +154,27 @@ def main():
     if args.exp in ("w11i32", "allw"):
         exp_variant("winchunk11-i32-G2048", tile=(16, 128),
                     tbl_dtype="int32", win_chunk=11)
+    if args.exp in ("rolled", "ab"):
+        # round-3 rolled body: first-call time here IS the cold-start
+        # number (trace seconds, not minutes); slope vs the unrolled body
+        # is the runtime A/B
+        exp_variant("rolled-w11", body="rolled", win_chunk=11)
+    if args.exp in ("unrolled", "ab"):
+        exp_variant("unrolled-w11", body="unrolled", win_chunk=11)
+    if args.exp in ("rolledB8",):
+        # production dispatch shape: 8 stacked batches
+        from ed25519_consensus_tpu.ops import pallas_msm
+
+        sc, pts, digits, packed = build_operands(12288, B=8)
+        fn = lambda d, p: pallas_msm.pallas_window_sums_many(  # noqa
+            d, p, body="rolled", win_chunk=11)
+        t0 = time.perf_counter()
+        np.asarray(fn(digits, packed))
+        print(f"#   B=8 N=12288 rolled: first call (trace+compile+run) "
+              f"{time.perf_counter()-t0:.1f}s", flush=True)
+        t = timed_calls(fn, digits, packed)
+        print(f"#   B=8 N=12288 rolled: {t*1000:.1f} ms/call "
+              f"({t*1000/8:.1f} ms/batch)", flush=True)
     sys.stdout.flush()
     os._exit(0)
 
