@@ -86,6 +86,38 @@ def test_native_msm_parity():
         assert got == want
 
 
+def test_native_msm_signed_digit_edges():
+    """The IFMA path recodes scalars to signed radix-16 digits (9-entry
+    tables, round 3): pin the recode edge nibbles — 8 stays, 9/15 borrow
+    with carry, full-0xF chains carry across every window, and the
+    2^256-1 top carry lands in window 64 — at IFMA sizes (n >= 16)."""
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    import random
+
+    rng2 = random.Random(0x51DE)
+    edge = [
+        0x8888888888888888888888888888888888888888888888888888888888888888 % (1 << 256),
+        0x9999999999999999999999999999999999999999999999999999999999999999 % (1 << 256),
+        (1 << 256) - 1,
+        (1 << 255) - 1,
+        8, 9, 15, 16,
+        0x7FF8000000000000000000000000000000000000000000000000000000000008,
+    ]
+    n = 24  # > 16 so table_build8_x2 + the 8-wide tail both run
+    scalars = edge + [rng2.randrange(0, 1 << 256)
+                      for _ in range(n - len(edge))]
+    tors = edwards.eight_torsion()
+    points = [
+        edwards.BASEPOINT.scalar_mul(rng2.randrange(1, L)).add(
+            tors[rng2.randrange(8)]
+        )
+        for _ in range(n)
+    ]
+    assert native.vartime_msm(scalars, points) == \
+        edwards.multiscalar_mul(scalars, points)
+
+
 def test_native_check_prehashed_parity():
     """check_prehashed must match the exact Python cofactored equation on
     valid, tampered, and small-order inputs."""
