@@ -501,23 +501,53 @@ class _DeviceLane:
     seized tunnel is abandoned (the thread is left to die with the
     process) and a fresh lane is created after the health cooldown."""
 
-    _instance = None
+    # One lane PER DISPATCH MODE (0 = single device, D = D-device mesh):
+    # concurrent verify_many callers with different modes must not tear
+    # down each other's lane mid-call (queued chunks would be lost and
+    # the deadline miss would falsely cooldown the device).  Device-call
+    # serialization is DEVICE_CALL_LOCK's job, not the registry's, so
+    # coexisting workers are safe — just one thread parked per mode.
+    _instances = {}
     _instance_lock = threading.Lock()
 
     @classmethod
-    def get(cls) -> "_DeviceLane":
-        # Two concurrent verify_many callers must not each build a lane:
-        # duplicate workers would contend for DEVICE_CALL_LOCK and orphan
-        # one thread per race.
+    def get(cls, mesh: int = 0) -> "_DeviceLane":
+        # mesh <= 1 dispatches identically to single-device: normalize so
+        # mode 1 and mode 0 share a lane, its shapes, and its grace state.
+        mesh = int(mesh) if mesh and int(mesh) > 1 else 0
+        # Two concurrent same-mode callers must not each build a lane.
         with cls._instance_lock:
-            if cls._instance is None or not cls._instance.healthy():
-                cls._instance = cls()
-            return cls._instance
+            inst = cls._instances.get(mesh)
+            if inst is None or not inst.healthy():
+                inst = cls(mesh=mesh)
+                cls._instances[mesh] = inst
+            return inst
 
-    def __init__(self):
+    @classmethod
+    def reset_all(cls, timeout: float = 5.0) -> bool:
+        """Shut down every lane worker (tests, driver dry runs).  A lane
+        is dropped from the registry only once its thread actually
+        exited, so the atexit drain can retry a worker that was still
+        mid-call; returns True when no worker remains alive."""
+        with cls._instance_lock:
+            lanes = list(cls._instances.items())
+        all_dead = True
+        for mode, inst in lanes:
+            if inst._thread.is_alive():
+                inst.shutdown(timeout=timeout)
+            if inst._thread.is_alive():
+                all_dead = False
+                continue
+            with cls._instance_lock:
+                if cls._instances.get(mode) is inst:
+                    del cls._instances[mode]
+        return all_dead
+
+    def __init__(self, mesh: int = 0):
         import queue
         import threading
 
+        self._mesh = int(mesh or 0)
         self._q = queue.Queue()
         self._results = {}
         self._discarded = set()
@@ -575,12 +605,12 @@ class _DeviceLane:
     def abandon(self) -> None:
         self._abandoned = True
         _device_lane_stuck[0] = True
-        # Clear the singleton only if it is still THIS lane: a second
+        # Deregister only if the registry still holds THIS lane: a second
         # caller's stale abandon must not discard a freshly rebuilt
         # healthy lane (and orphan its worker).
         with type(self)._instance_lock:
-            if type(self)._instance is self:
-                type(self)._instance = None
+            if type(self)._instances.get(self._mesh) is self:
+                del type(self)._instances[self._mesh]
 
     def shutdown(self, timeout: float = 5.0) -> None:
         """Stop the worker before interpreter teardown: a thread parked
@@ -614,12 +644,19 @@ class _DeviceLane:
                     t_call = _time.monotonic()
                     with self._cv:
                         self._started[cid] = t_call
-                    out = np.asarray(
-                        _msm.dispatch_window_sums_many(digits, pts)
-                    )
+                    if self._mesh > 1:
+                        from .parallel import sharded_msm as _sh
+
+                        out = np.asarray(_sh.sharded_window_sums_many(
+                            digits, pts, self._mesh))
+                    else:
+                        out = np.asarray(
+                            _msm.dispatch_window_sums_many(digits, pts)
+                        )
                 # Fetch done ⇒ any first-compile for this shape is over:
                 # subsequent calls are held to the normal deadline.
-                _msm.mark_shape_completed(digits.shape[0], digits.shape[2])
+                _msm.mark_shape_completed(digits.shape[0], digits.shape[2],
+                                          self._mesh)
             except Exception:  # device error: caller decides on host
                 import os as _os
 
@@ -643,9 +680,7 @@ class _DeviceLane:
 
 
 def _shutdown_device_lane():
-    inst = _DeviceLane._instance
-    if inst is not None and inst.healthy():
-        inst.shutdown()
+    _DeviceLane.reset_all()
 
 
 import atexit  # noqa: E402  (registration belongs next to the lane)
@@ -739,7 +774,8 @@ def _merge_groups(verifiers):
 
 
 def verify_many(verifiers, rng=None, chunk: int = 8,
-                hybrid: bool = True, merge: str = "auto") -> "list[bool]":
+                hybrid: bool = True, merge: str = "auto",
+                mesh: int | None = None) -> "list[bool]":
     """Verify MANY independent batches with union-merging, chunked
     double-buffered device calls, and an opportunistic host lane.
 
@@ -784,7 +820,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                       for g in groups]
             t0 = _time.monotonic()
             union_verdicts = verify_many(
-                unions, rng=rng, chunk=chunk, hybrid=hybrid, merge="never"
+                unions, rng=rng, chunk=chunk, hybrid=hybrid,
+                merge="never", mesh=mesh
             )
             stats = dict(last_run_stats)
             verdicts = [False] * len(verifiers)
@@ -870,7 +907,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 idxs.append(i)
         if not staged:
             return None
-        pad = max(msm.preferred_pad(s.n_device_terms) for s in staged)
+        if mesh and mesh > 1:
+            from .parallel.sharded_msm import shard_pad
+
+            pad = max(shard_pad(s.n_device_terms, mesh) for s in staged)
+        else:
+            pad = max(msm.preferred_pad(s.n_device_terms) for s in staged)
         ops = [s.device_operands(lambda n: pad) for s in staged]
         digits = np.stack([d for d, _ in ops])
         pts = np.stack([p for _, p in ops])
@@ -927,9 +969,22 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         while remaining:
             host_verify_one(remaining.pop())
         return _finish(verdicts)
-    dev = _DeviceLane.get()
+    # mesh <= 1 is single-device dispatch: normalize so the lane, the
+    # shard padding, and the shape-completed grace keys all agree with
+    # the mesh=None path.
+    mesh = int(mesh) if mesh and int(mesh) > 1 else 0
+    dev = _DeviceLane.get(mesh=mesh)
 
-    ema_per_batch = 0.2  # seconds per batch; pessimistic prior
+    # Seconds-per-batch prior before the first measurement; the deadline
+    # budget is 3×EMA×batches (2 s floor).  The default fits real TPU
+    # call times; ED25519_TPU_EMA_PRIOR overrides for legitimately slow
+    # lanes (e.g. the virtual CPU mesh in dry runs, where a sharded call
+    # can take tens of seconds without being sick).
+    try:
+        ema_per_batch = float(
+            _os.environ.get("ED25519_TPU_EMA_PRIOR", "") or 0.2)
+    except ValueError:
+        ema_per_batch = 0.2
     ema_is_prior = True
     outstanding = []  # [(chunk_id, real idxs, t_submit, padded batches)]
     device_sick = False
@@ -956,7 +1011,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         while outstanding:
             cid, idxs, t0, padded_b, n_lanes = outstanding[0]
             budget = max(3.0 * ema_per_batch * padded_b, 2.0)
-            if ema_is_prior and not msm.shape_completed(padded_b, n_lanes):
+            if ema_is_prior and not msm.shape_completed(
+                    padded_b, n_lanes, mesh or 0):
                 # No measurement yet AND no call for this padded shape has
                 # ever completed: the call may be sitting in a first-shape
                 # kernel compile (minutes through a remote-compile tunnel)
@@ -1050,7 +1106,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # to trusting the device (with the normal short deadline).
         grace_hybrid = (not hybrid and ema_is_prior and outstanding
                         and not msm.shape_completed(outstanding[0][3],
-                                                    outstanding[0][4]))
+                                                    outstanding[0][4],
+                                                    mesh or 0))
         lane_hybrid = hybrid or grace_hybrid
         # host lane: steal one batch from the tail, then re-poll
         if lane_hybrid and remaining and outstanding:

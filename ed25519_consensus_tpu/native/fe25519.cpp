@@ -879,7 +879,9 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
     // read the NEXT term's signed digits.
     for (uint64_t t = 0; t < n; t++)
         recode_signed64(scalars + 32 * t, digs + NDIG_PAD * t);
-    // 2p per limb (radix-51): for the masked Niels negation 2p - x.
+    // 4p per limb (radix-51; 0xFFFFFFFFFFFDA is already the 2p limb):
+    // for the masked Niels negation 4p - x, matching fe8_sub's bias
+    // convention and bounds.
     const __m512i p2_0 = _mm512_set1_epi64(0xFFFFFFFFFFFDAULL * 2);
     const __m512i p2_i = _mm512_set1_epi64(0xFFFFFFFFFFFFEULL * 2);
     const __m512i twenty = _mm512_set1_epi64(20);

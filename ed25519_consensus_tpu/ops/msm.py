@@ -108,12 +108,14 @@ def ensure_compile_cache():
 _shapes_completed = set()
 
 
-def mark_shape_completed(n_batches: int, n_lanes: int) -> None:
-    _shapes_completed.add((int(n_batches), int(n_lanes)))
+def mark_shape_completed(n_batches: int, n_lanes: int,
+                         mesh: int = 0) -> None:
+    _shapes_completed.add((int(n_batches), int(n_lanes), int(mesh or 0)))
 
 
-def shape_completed(n_batches: int, n_lanes: int) -> bool:
-    return (int(n_batches), int(n_lanes)) in _shapes_completed
+def shape_completed(n_batches: int, n_lanes: int, mesh: int = 0) -> bool:
+    return (int(n_batches), int(n_lanes),
+            int(mesh or 0)) in _shapes_completed
 
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128

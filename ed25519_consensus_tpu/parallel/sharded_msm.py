@@ -81,6 +81,79 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
     return jax.jit(fn), mesh
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
+                                  lanes_per_device: int, nwin: int,
+                                  affine: bool = False):
+    """Batched mesh kernel for the throughput scheduler: B stacked
+    verification batches, each one's MSM terms sharded over the device
+    mesh, partial Edwards sums all-gathered and folded per batch — one
+    launch for the whole chunk, exactly like the single-device
+    dispatch_window_sums_many but data-parallel over the mesh.
+
+    Global shapes: digits (B, nwin, N), points (B, 2|4, NLIMBS, N) with
+    N = n_devices · lanes_per_device → replicated (B, 4, NLIMBS, nwin)."""
+    msm_lib.ensure_compile_cache()
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..ops import jnp_edwards as E
+    import jax.numpy as jnp
+
+    mesh = mesh_lib.batch_mesh(n_devices)
+    axis = mesh_lib.BATCH_AXIS
+    local_kernel = msm_lib._compiled_kernel.__wrapped__(
+        lanes_per_device, nwin
+    )
+
+    def shard_fn(digits, points):
+        # per-device: (B, nwin, N/D), (B, 2|4, NLIMBS, N/D)
+        if affine:
+            points = msm_lib.expand_affine_points(points)
+        part = jax.vmap(local_kernel)(digits, points)  # (B,4,NLIMBS,nwin)
+        # point tensors lead with (4, NLIMBS) for the Edwards fold
+        part = jnp.transpose(part, (1, 2, 0, 3))  # (4, NLIMBS, B, nwin)
+        gathered = jax.lax.all_gather(part, axis)  # (D, 4, NLIMBS, B, nwin)
+
+        def fold(acc, p):
+            return E.point_add(acc, p), None
+
+        out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]), gathered)
+        return jnp.transpose(out, (2, 0, 1, 3))  # (B, 4, NLIMBS, nwin)
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, None, None, axis)),
+        out_specs=P(),
+    )
+    try:
+        fn = shard_map(shard_fn, check_vma=False, **kwargs)
+    except TypeError:
+        fn = shard_map(shard_fn, check_rep=False, **kwargs)
+    return jax.jit(fn)
+
+
+def sharded_window_sums_many(digits, pts, n_devices: int):
+    """Batched mesh dispatch (the scheduler's device-lane call when a
+    mesh is configured): digits (B, nwin, N), points in the legacy or
+    affine wire format → (B, 4, NLIMBS, nwin) device array."""
+    return _compiled_sharded_kernel_many(
+        n_devices, digits.shape[0], digits.shape[2] // n_devices,
+        digits.shape[1], affine=pts.shape[1] == 2,
+    )(digits, pts)
+
+
+def shard_pad(n: int, n_devices: int) -> int:
+    """Public shard padding (batch.verify_many uses this when a mesh is
+    configured)."""
+    return _shard_pad(n, n_devices)
+
+
 def _shard_pad(n: int, n_devices: int) -> int:
     """Pad the term count so each device holds an equal power-of-two
     shard."""

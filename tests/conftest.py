@@ -32,7 +32,4 @@ def _shutdown_device_lane_at_session_end():
     yield
     from ed25519_consensus_tpu import batch
 
-    inst = batch._DeviceLane._instance
-    if inst is not None and inst.healthy():
-        inst.shutdown()
-    batch._DeviceLane._instance = None
+    batch._DeviceLane.reset_all()

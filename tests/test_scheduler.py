@@ -27,10 +27,7 @@ def reset_device_state():
     """Reset the module-level scheduler state (cooldowns, lane singleton)
     so tests are order-independent."""
     yield
-    inst = batch._DeviceLane._instance
-    if inst is not None and inst.healthy():
-        inst.shutdown(timeout=5.0)
-    batch._DeviceLane._instance = None
+    batch._DeviceLane.reset_all()
     batch.reset_device_health()
     batch.last_run_stats.clear()
 
@@ -154,7 +151,7 @@ def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     assert batch.device_lane_stuck()
     assert batch._device_cooldown_until[0] > t0  # cooldown armed
     # the sick lane was abandoned: a fresh get() builds a new one
-    assert batch._DeviceLane._instance is None
+    assert batch._DeviceLane._instances.get(0) is None
 
 
 def test_unwarmed_first_call_gets_compile_grace(monkeypatch):
@@ -265,7 +262,7 @@ def test_host_overtake_discards_inflight_chunk(monkeypatch):
     assert stats["device_batches"] == 0
     assert discards  # the gated probe chunk was overtaken and dropped
     # the dropped result must not leak into the lane's result map
-    lane = batch._DeviceLane._instance
+    lane = batch._DeviceLane._instances.get(0)
     release.set()
     deadline = time.monotonic() + 10.0
     while lane._discarded and time.monotonic() < deadline:
