@@ -240,9 +240,13 @@ def decompress_batch_buffer(blob: bytes, n: int):
         out = ctypes.create_string_buffer(128 * n)
         ok = ctypes.create_string_buffer(n)
         lib.zip215_decompress_batch(blob, n, out, ok)
+        # frombuffer on the ctypes buffer itself is a zero-copy view
+        # (one .copy() to own it) — .raw would copy the whole buffer an
+        # extra time per access
         return (
-            np.frombuffer(out.raw, dtype=np.uint8).reshape(n, 128).copy(),
-            np.frombuffer(ok.raw, dtype=np.uint8).copy(),
+            np.frombuffer(out, dtype=np.uint8,
+                          count=128 * n).reshape(n, 128).copy(),
+            np.frombuffer(ok, dtype=np.uint8, count=n).copy(),
         )
     # Exact-Python fallback (CI without a toolchain).
     from ..ops import edwards
@@ -400,7 +404,12 @@ def vartime_msm_scblob(sblob: bytes, raw_points):
             scalars, [point_from_raw(r) for r in raw_points]
         )
     out = ctypes.create_string_buffer(128)
-    lib.edwards_vartime_msm(sblob, raw_points.tobytes(), n, out)
+    import numpy as np
+
+    pts = np.ascontiguousarray(raw_points)  # no-op for staged buffers
+    lib.edwards_vartime_msm(
+        sblob, pts.ctypes.data_as(ctypes.c_char_p), n, out
+    )
     return point_from_raw(out.raw)
 
 
