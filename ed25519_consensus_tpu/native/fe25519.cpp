@@ -853,13 +853,16 @@ IFMA_TARGET static void table_build8_x2(const uint8_t *points,
 IFMA_TARGET static void straus_accumulate8(const u64 *tables,
                                            const uint8_t *scalars,
                                            uint64_t n, u64 *sums) {
-    // RAII holder: reclaimed at thread exit; pointer nulled BEFORE the
-    // grow `new` so a bad_alloc can't leave a dangling pointer that a
-    // retry would double-free.
+    // Grow-only holder, INTENTIONALLY immortal: a thread_local
+    // destructor here runs during process/thread teardown interleaved
+    // with the embedding runtime's own exit handlers — measured as a
+    // SIGSEGV at pytest exit when it freed these buffers — so the
+    // per-thread allocation is deliberately left to the OS at exit.
+    // The pointer is nulled BEFORE the grow `new` so a bad_alloc can't
+    // leave a dangling pointer that a retry would double-free.
     struct digs_holder {
         int8_t *p = nullptr;
         uint64_t cap = 0;
-        ~digs_holder() { delete[] p; }
     };
     static thread_local digs_holder db;
     if (db.cap < NDIG_PAD * n) {
@@ -1039,10 +1042,11 @@ static void edwards_vartime_msm_chunk(const uint8_t *scalars,
         // buffer: a fresh 14.5 MB allocation per call costs ~3.5k pages
         // of first-touch faults (~7M cycles measured); steady-state
         // batches reuse hot pages.
+        // intentionally immortal — see digs_holder in
+        // straus_accumulate8 for the teardown rationale
         struct tbl_holder {
             ge *p = nullptr;
             uint64_t cap = 0;
-            ~tbl_holder() { delete[] p; }
         };
         static thread_local tbl_holder tb;
         if (tb.cap < n * (uint64_t)stride) {
