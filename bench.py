@@ -427,6 +427,15 @@ def main():
             depth = 1
             print("# parity gate timed out holding the device-call "
                   "lock: falling back to backend=host", file=sys.stderr)
+        elif parity.startswith(("fail", "error")):
+            # A kernel that just failed (or errored out of) the
+            # bit-exact parity gate must not supply the published
+            # device-backend number: its measurements are disqualified,
+            # not just annotated.  The host path is always exact.
+            backend = "host"
+            depth = 1
+            print(f"# parity gate DISQUALIFIED the device ({parity}): "
+                  "falling back to backend=host", file=sys.stderr)
 
     if backend == "device" and depth > 1:
         # Warm the scheduler's device shapes (probe=2, chunk=8) OUTSIDE

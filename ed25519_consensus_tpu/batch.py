@@ -485,6 +485,17 @@ _device_lane_stuck = [False]
 # After a call where the probe completed but the device won zero batches,
 # skip probing for a while (the probe costs real host time every call).
 _device_uncompetitive_until = [0.0]
+# Consecutive verify_many calls whose probe never RESOLVED (no timing
+# measurement, no device win — e.g. a permanently degraded link where the
+# host drains the pool before every probe returns, or a probe that errors
+# every call).  One unresolved probe is not evidence (the kernel may have
+# been cold-compiling); a streak is — after _UNRESOLVED_PROBE_LIMIT of
+# them a SHORTER re-probe backoff arms, bounding the per-call probe tax
+# (staging + dispatch of a full-chunk probe) that a degraded link would
+# otherwise pay on every single call forever.
+_unresolved_probe_streak = [0]
+_UNRESOLVED_PROBE_LIMIT = 2
+_UNRESOLVED_PROBE_PAUSE = 30.0
 
 # Observability (SURVEY.md §5): counters for the most recent verify_many
 # call — batch/signature totals, the device/host lane split, and wall
@@ -508,6 +519,12 @@ class _DeviceLane:
     # serialization is DEVICE_CALL_LOCK's job, not the registry's, so
     # coexisting workers are safe — just one thread parked per mode.
     _instances = {}
+    # Abandoned-but-possibly-alive lanes: abandon() moves a lane here so
+    # get() never hands it out again, while the atexit reset_all drain can
+    # still retry a worker that was parked inside the accelerator runtime
+    # when it was abandoned (a live worker at interpreter teardown aborts
+    # the process).
+    _abandoned_instances = []
     _instance_lock = threading.Lock()
 
     @classmethod
@@ -531,6 +548,7 @@ class _DeviceLane:
         mid-call; returns True when no worker remains alive."""
         with cls._instance_lock:
             lanes = list(cls._instances.items())
+            abandoned = list(cls._abandoned_instances)
         all_dead = True
         for mode, inst in lanes:
             if inst._thread.is_alive():
@@ -541,6 +559,15 @@ class _DeviceLane:
             with cls._instance_lock:
                 if cls._instances.get(mode) is inst:
                     del cls._instances[mode]
+        for inst in abandoned:
+            if inst._thread.is_alive():
+                inst.shutdown(timeout=timeout)
+            if inst._thread.is_alive():
+                all_dead = False
+                continue
+            with cls._instance_lock:
+                if inst in cls._abandoned_instances:
+                    cls._abandoned_instances.remove(inst)
         return all_dead
 
     def __init__(self, mesh: int = 0):
@@ -607,10 +634,15 @@ class _DeviceLane:
         _device_lane_stuck[0] = True
         # Deregister only if the registry still holds THIS lane: a second
         # caller's stale abandon must not discard a freshly rebuilt
-        # healthy lane (and orphan its worker).
+        # healthy lane (and orphan its worker).  The lane moves to the
+        # abandoned side registry (not oblivion) so the atexit reset_all
+        # drain can still retry its worker — see _abandoned_instances.
         with type(self)._instance_lock:
             if type(self)._instances.get(self._mesh) is self:
                 del type(self)._instances[self._mesh]
+            if (self._thread.is_alive()
+                    and self not in type(self)._abandoned_instances):
+                type(self)._abandoned_instances.append(self)
 
     def shutdown(self, timeout: float = 5.0) -> None:
         """Stop the worker before interpreter teardown: a thread parked
@@ -696,6 +728,7 @@ def reset_device_health() -> None:
     _device_cooldown_until[0] = 0.0
     _device_uncompetitive_until[0] = 0.0
     _device_lane_stuck[0] = False
+    _unresolved_probe_streak[0] = 0
 
 
 def device_lane_stuck() -> bool:
@@ -857,20 +890,32 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         "device_batches": 0,
         "device_sick": False,
         "device_measured": False,  # a chunk completed and updated the EMA
+        "probed": False,  # a probe chunk was actually dispatched
         "seconds": 0.0,
     }
 
     def _finish(result):
         stats["seconds"] = _time.monotonic() - _t_begin
         if (stats["batches"] >= 8 and stats["device_batches"] == 0
-                and stats.get("device_measured")
                 and not stats["device_sick"] and stats["host_batches"]):
-            # the device was MEASURED and still lost every race this
-            # call: pause probing.  An unresolved probe (e.g. first-call
-            # kernel compile still in flight when the host drained the
-            # pool) is NOT evidence of uncompetitiveness — the next call
-            # probes again against the now-warm kernel.
-            _device_uncompetitive_until[0] = _time.monotonic() + 60.0
+            if stats.get("device_measured"):
+                # the device was MEASURED and still lost every race this
+                # call: pause probing.
+                _device_uncompetitive_until[0] = _time.monotonic() + 60.0
+                _unresolved_probe_streak[0] = 0
+            elif stats.get("probed"):
+                # The probe never resolved (no timing, no win — compile
+                # still in flight, a seized-but-not-sick link, or an
+                # error every call).  One is not evidence (the next call
+                # probes the now-warm kernel); a STREAK is — arm a
+                # shorter backoff so a permanently degraded link stops
+                # paying a full-chunk probe on every call.
+                _unresolved_probe_streak[0] += 1
+                if _unresolved_probe_streak[0] >= _UNRESOLVED_PROBE_LIMIT:
+                    _device_uncompetitive_until[0] = (
+                        _time.monotonic() + _UNRESOLVED_PROBE_PAUSE)
+        elif stats.get("device_measured") or stats["device_batches"]:
+            _unresolved_probe_streak[0] = 0
         last_run_stats.clear()
         last_run_stats.update(stats)
         return result
@@ -1094,6 +1139,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             # steady-state per-chunk economics
             submit(size=min(2, chunk))
             probed = True
+            stats["probed"] = True
         while (remaining and len(outstanding) < 2 and not device_failed
                and not ema_is_prior and device_competitive()):
             submit()

@@ -56,20 +56,26 @@ def _build() -> str:
     return _SO
 
 
+def _disabled_by_request() -> bool:
+    """ED25519_TPU_DISABLE_NATIVE opt-out, re-checked on every load()
+    call: a disable is its own state, NOT a latched failure — unsetting
+    the env var mid-process re-enables the library, and `_lib_failed`
+    keeps meaning exactly 'build/load/self-check failed'."""
+    return os.environ.get("ED25519_TPU_DISABLE_NATIVE", "").lower() in (
+        "1", "true", "yes"
+    )  # explicit opt-outs only: "0"/"false" must NOT disable
+
+
 def load():
     """Return the ctypes library, building it if needed; None if
     unavailable (no toolchain, load failure, failed self-check, or
     disabled via ED25519_TPU_DISABLE_NATIVE=1 — every caller has an
     exact-Python fallback, so disabling trades speed for nothing)."""
     global _lib, _lib_failed
+    if _disabled_by_request():
+        return None
     if _lib is not None or _lib_failed:
         return _lib
-    if os.environ.get("ED25519_TPU_DISABLE_NATIVE", "").lower() in (
-        "1", "true", "yes"
-    ):
-        # explicit opt-outs only: "0"/"false" must NOT disable
-        _lib_failed = True
-        return None
     try:
         lib = ctypes.CDLL(_build())
         lib.zip215_decompress_batch.argtypes = [

@@ -24,9 +24,12 @@ per-window sums), hand-blocked for the VPU:
   one launch).
 * Limb arithmetic is the same balanced-signed 20×13-bit scheme as
   jnp_field.py (identical carry-step counts; the closure proofs in that
-  module's docstring apply verbatim) — over Python LISTS of (32, 128)
-  int32 tiles, fully unrolled, so Mosaic keeps the schoolbook product in
-  registers.
+  module's docstring apply verbatim) — over whole (NLIMBS, S, L) int32
+  arrays, so one jnp op covers all 20 limbs and the traced body stays a
+  few thousand equations (the round-2 list-of-tiles body, which unrolled
+  every limb pair, stopped compiling at the production B = 8 shape in
+  round 3 and was removed in round 4 — a fallback that cannot compile at
+  any shipped shape is risk, not redundancy).
 
 The final Horner combine over windows stays exact host bigint math
 (ops/msm.py).  Parity with the exact host arithmetic is pinned three ways:
@@ -52,65 +55,17 @@ GROUP = SUBLANES * LANES  # 4096 terms per grid step
 FOLD_SUBLANES = 8         # fold each block down to (8, 128) lanes
 
 
-# -- field ops over lists of (32, 128) int32 tiles -------------------------
-# Semantics and carry-step counts match ops/jnp_field.py exactly (same
-# balanced-limb bounds U: |limb| ≤ 8191; proofs in that module).
-
-
-def _carry(xs, steps):
-    for _ in range(steps):
-        cs = [(x + _HALF) >> LIMB_BITS for x in xs]
-        rs = [x - (c << LIMB_BITS) for x, c in zip(xs, cs)]
-        xs = [rs[0] + cs[-1] * FOLD] + [
-            rs[i] + cs[i - 1] for i in range(1, len(xs))
-        ]
-    return xs
-
-
-def _fadd(a, b):
-    return _carry([x + y for x, y in zip(a, b)], 1)
-
-
-def _fsub(a, b):
-    return _carry([x - y for x, y in zip(a, b)], 1)
-
-
-def _fmul_small(a, k):
-    return _carry([x * k for x in a], 1)
-
-
-def _fmul(a, b):
-    import jax.numpy as jnp
-
-    wide = [None] * (2 * NLIMBS - 1)
-    for i in range(NLIMBS):
-        ai = a[i]
-        for j in range(NLIMBS):
-            p = ai * b[j]
-            k = i + j
-            wide[k] = p if wide[k] is None else wide[k] + p
-    zero = jnp.zeros_like(wide[0])
-    wide = wide + [zero, zero]  # two columns absorb the wide carries
-    for _ in range(2):
-        cs = [(x + _HALF) >> LIMB_BITS for x in wide]
-        rs = [x - (c << LIMB_BITS) for x, c in zip(wide, cs)]
-        wide = [rs[0]] + [rs[i] + cs[i - 1] for i in range(1, len(wide))]
-    low = [wide[i] + wide[NLIMBS + i] * FOLD for i in range(NLIMBS)]
-    low[0] = low[0] + wide[2 * NLIMBS] * (FOLD * FOLD)
-    return _carry(low, 5)
-
-
 _D2_LIMBS = [int(v) for v in limbs_mod.int_to_limbs(D2 % P)]
 
 
-# -- field ops over WHOLE (NLIMBS, S, L) int32 arrays ("rolled" body) ------
-# Same balanced-limb semantics and carry-step counts as the list-of-tiles
-# ops above (and as jnp_field.py — its closure proofs apply verbatim); the
-# difference is purely trace size: one jnp op covers all 20 limbs, and the
-# schoolbook product is 20 shifted multiply-accumulates instead of 400
-# per-limb-pair products.  This is what turns the kernel's traced body
-# from ~400k equations (~3 min of Python tracing per shape, never cached)
-# into a few thousand.
+# -- field ops over WHOLE (NLIMBS, S, L) int32 arrays ----------------------
+# Same balanced-limb semantics and carry-step counts as jnp_field.py (its
+# closure proofs apply verbatim); the difference is purely trace size: one
+# jnp op covers all 20 limbs, and the schoolbook product is 20 shifted
+# multiply-accumulates instead of 400 per-limb-pair products.  This is
+# what turns the kernel's traced body from the ~400k equations of the
+# removed list-of-tiles body (~3 min of Python tracing per shape, never
+# cached) into a few thousand.
 
 
 def _carry_a(x, steps, fold=True):
@@ -199,30 +154,6 @@ def _padd_a(p, q):
     ])
 
 
-def _padd(p, q):
-    """Complete unified addition (add-2008-hwcd-3, a=-1) on 4×NLIMBS limb
-    lists — same formula as jnp_edwards.point_add."""
-    import jax.numpy as jnp
-
-    X1, Y1, Z1, T1 = p
-    X2, Y2, Z2, T2 = q
-    A = _fmul(_fsub(Y1, X1), _fsub(Y2, X2))
-    B = _fmul(_fadd(Y1, X1), _fadd(Y2, X2))
-    d2 = [jnp.full(T1[0].shape, v, jnp.int32) for v in _D2_LIMBS]
-    C = _fmul(_fmul(T1, d2), T2)
-    Dv = _fmul_small(_fmul(Z1, Z2), 2)
-    E = _fsub(B, A)
-    Fv = _fsub(Dv, C)
-    G = _fadd(Dv, C)
-    H = _fadd(B, A)
-    return (
-        _fmul(E, Fv),
-        _fmul(G, H),
-        _fmul(Fv, G),
-        _fmul(E, H),
-    )
-
-
 @functools.lru_cache(maxsize=None)
 def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
                                    nwin: int = NWINDOWS,
@@ -231,14 +162,12 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
                                    tbl_dtype="int16",
                                    win_chunk: int = 1,
                                    unroll_windows: bool = False):
-    """The `rolled` kernel body: identical math and data layout to the
-    unrolled kernel below, but field elements are whole (NLIMBS, S, L)
+    """The `rolled` kernel body: field elements are whole (NLIMBS, S, L)
     arrays and the select/window loops are `fori_loop`s with dynamic ref
-    indices (the table-build loop already relied on those), so the traced
-    body is a few thousand equations instead of ~400k — cold trace drops
-    from minutes to seconds per shape.  Parity is pinned by the same
-    interpret-mode tests and the on-hardware 196-matrix as the unrolled
-    body.
+    indices, so the traced body is a few thousand equations instead of
+    the ~400k the removed round-2 list-of-tiles body traced — cold trace
+    is seconds per shape, not minutes.  Parity is pinned by the
+    interpret-mode tests and the on-hardware 196-matrix.
 
     `unroll_windows` is the `hybrid` style: keep the array-representation
     field math (small trace) but statically unroll the per-step window
@@ -352,143 +281,7 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _compiled_pallas_kernel(n_batches: int, n_blocks: int,
-                            nwin: int = NWINDOWS,
-                            interpret: bool = False,
-                            tile=(SUBLANES, LANES),
-                            tbl_dtype="int16",
-                            win_chunk: int = 1):
-    """digits (B, nwin, nb, S, L) int8 (signed, d ∈ [-8, 8]),
-    points (B, 4, NLIMBS, nb, S, L) int16
-    → per-block partial window sums (B, nb, nwin, 4, NLIMBS, fS, L) int16.
-
-    `tile` is the (sublane, lane) block shape — (32, 128) on hardware;
-    interpreter-mode tests shrink it so tiny cases stay fast.
-    `win_chunk` processes that many windows per grid step (must divide
-    nwin) to amortize per-step fixed costs."""
-    from .msm import ensure_compile_cache
-
-    ensure_compile_cache()
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    S, Ln = tile
-    fS = min(FOLD_SUBLANES, S)
-    tdt = jnp.int16 if tbl_dtype == "int16" else jnp.int32
-    W = win_chunk
-    assert nwin % W == 0
-
-    def kernel(dig_ref, pts_ref, out_ref, tbl_ref):
-        w = pl.program_id(2)
-
-        def write_tbl(k, p):
-            for c in range(4):
-                for l in range(NLIMBS):
-                    tbl_ref[k, c, l] = p[c][l].astype(tdt)
-
-        # --- table build once per (batch, block), at the first window ----
-        @pl.when(w == 0)
-        def _build_table():
-            pt = tuple(
-                [pts_ref[0, c, l, 0].astype(jnp.int32)
-                 for l in range(NLIMBS)]
-                for c in range(4)
-            )
-            zero = jnp.zeros((S, Ln), jnp.int32)
-            one = jnp.ones((S, Ln), jnp.int32)
-            ident_pt = (
-                [zero] * NLIMBS,
-                [one] + [zero] * (NLIMBS - 1),
-                [one] + [zero] * (NLIMBS - 1),
-                [zero] * NLIMBS,
-            )
-            write_tbl(0, ident_pt)
-            write_tbl(1, pt)
-
-            def table_body(k, _):
-                prev = tuple(
-                    [tbl_ref[k - 1, c, l].astype(jnp.int32)
-                     for l in range(NLIMBS)]
-                    for c in range(4)
-                )
-                write_tbl(k, _padd(prev, pt))
-                return 0
-
-            jax.lax.fori_loop(2, 9, table_body, 0)
-
-        # --- this step's windows: select + in-block lane fold (all
-        # indices static — windows are unrolled within the step and the
-        # window chunk is a grid axis, so the hot path has no dynamic
-        # VMEM addressing at all) -----------------------------------------
-        for wi in range(W):
-            d = dig_ref[0, wi, 0].astype(jnp.int32)  # (S, Ln)
-            mag = jnp.abs(d)
-            sel = None
-            for k in range(9):
-                mask = (mag == k).astype(jnp.int32)
-                entry = tuple(
-                    [tbl_ref[k, c, l].astype(jnp.int32)
-                     for l in range(NLIMBS)]
-                    for c in range(4)
-                )
-                contrib = tuple(
-                    [mask * limb for limb in coord] for coord in entry
-                )
-                sel = contrib if sel is None else tuple(
-                    [x + y for x, y in zip(sc, cc)]
-                    for sc, cc in zip(sel, contrib)
-                )
-            # negative digits: negate X and T (free in balanced limbs)
-            sgn = jnp.where(d < 0, jnp.int32(-1), jnp.int32(1))
-            sel = (
-                [sgn * x for x in sel[0]],
-                sel[1],
-                sel[2],
-                [sgn * x for x in sel[3]],
-            )
-            # fold the sublane rows down by halving point-adds
-            s = S
-            while s > fS:
-                half = s // 2
-                lo = tuple([x[:half] for x in coord] for coord in sel)
-                hi = tuple([x[half:] for x in coord] for coord in sel)
-                sel = _padd(lo, hi)
-                s = half
-            for c in range(4):
-                for l in range(NLIMBS):
-                    out_ref[0, 0, wi, c, l] = sel[c][l].astype(jnp.int16)
-
-    return pl.pallas_call(
-        kernel,
-        grid=(n_batches, n_blocks, nwin // W),
-        in_specs=[
-            pl.BlockSpec(
-                (1, W, 1, S, Ln), lambda b, i, w: (b, w, i, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 4, NLIMBS, 1, S, Ln),
-                lambda b, i, w: (b, 0, 0, i, 0, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, W, 4, NLIMBS, fS, Ln),
-            lambda b, i, w: (b, i, w, 0, 0, 0, 0),
-        ),
-        out_shape=jax.ShapeDtypeStruct(
-            (n_batches, n_blocks, nwin, 4, NLIMBS, fS, Ln),
-            jnp.int16,
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((9, 4, NLIMBS, S, Ln), tdt)
-        ],
-        interpret=interpret,
-    )
-
-
-_BODY_STYLES = ("rolled", "hybrid", "unrolled")
+_BODY_STYLES = ("rolled", "hybrid")
 
 
 def _body_style() -> str:
@@ -503,9 +296,12 @@ def _body_style() -> str:
     * `hybrid`: array-rep field math + statically unrolled windows —
       tens of seconds of trace; needs win_chunk ≤ 3 to stay under the
       remote compile helper's program-size failure threshold at B = 8.
-    * `unrolled`: the round-2 list-of-tiles body — minutes of trace,
-      kept as an A/B fallback; its B = 8 executable no longer compiles
-      through the r3 helper at all."""
+
+    The round-2 `unrolled` list-of-tiles body was REMOVED in round 4:
+    its B = 8 executable stopped compiling through the r3 helper
+    entirely (kernel_body_ab_r3.txt), and a fallback that cannot
+    compile at the shipped shape is risk, not redundancy.  An explicit
+    ED25519_TPU_PALLAS_BODY=unrolled falls back to `rolled`."""
     import os
 
     v = os.environ.get("ED25519_TPU_PALLAS_BODY", "rolled").lower()
@@ -532,17 +328,11 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     assert n_lanes % group == 0
     n_blocks = n_lanes // group
     style = body or _body_style()
-    if style == "unrolled":
-        kernel = _compiled_pallas_kernel(n_batches, n_blocks, nwin,
-                                         interpret=interpret, tile=tile,
-                                         tbl_dtype=tbl_dtype,
-                                         win_chunk=win_chunk)
-    else:
-        kernel = _compiled_pallas_kernel_rolled(
-            n_batches, n_blocks, nwin, interpret=interpret, tile=tile,
-            tbl_dtype=tbl_dtype, win_chunk=win_chunk,
-            unroll_windows=style == "hybrid",
-        )
+    kernel = _compiled_pallas_kernel_rolled(
+        n_batches, n_blocks, nwin, interpret=interpret, tile=tile,
+        tbl_dtype=tbl_dtype, win_chunk=win_chunk,
+        unroll_windows=style == "hybrid",
+    )
     fS = min(FOLD_SUBLANES, S)
 
     def pipeline(digits, points):

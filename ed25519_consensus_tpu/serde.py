@@ -10,13 +10,30 @@ serde modes: compact binary (bincode — covered here by each type's
 `to_bytes`/`from_bytes`, byte-exact) and human-readable formats (JSON &
 friends) — covered here.
 
-Human-readable convention: every type is a lowercase hex string of its
-compact encoding (64 hex chars for 32-byte types, 128 for signatures and
-signing keys).  `to_json`/`from_json` wrap the hex forms for callers that
-want a self-describing JSON document.  Deserializing a `VerificationKey`
-ALWAYS validates (decompression may fail -> MalformedPublicKey), exactly
-like the reference bridge; `VerificationKeyBytes` stays unvalidated by
-design (L1 validation-deferral invariant, SURVEY.md §1).
+Two human-readable layers:
+
+* **Hex convention (`to_hex`/`from_hex`, `to_json`/`from_json`)** — every
+  type is a lowercase hex string of its compact encoding (64 hex chars
+  for 32-byte types, 128 for signatures and signing keys).  This is THIS
+  PROJECT'S OWN convention — compact and self-describing — and is NOT
+  wire-compatible with documents produced by the reference's serde
+  derives.
+* **Reference-compatible layout (`to_ref_value`/`from_ref_value`,
+  `to_ref_json`/`from_ref_json`)** — byte-for-byte the structures the
+  reference's derives emit through a human-readable serializer like
+  serde_json: `Signature` as `{"R_bytes": [32 ints], "s_bytes":
+  [32 ints]}` (derived struct, src/signature.rs:6-11),
+  `VerificationKeyBytes`/`VerificationKey` as a bare 32-int array
+  (derived newtype, src/verification_key.rs:33 and the validating
+  try_from bridge at :107-109), `SigningKey` as a 64-int array of the
+  expanded secret key (hand-written tuple impl,
+  src/signing_key.rs:31-78).  Use this layer to interoperate with
+  reference-produced documents.
+
+Deserializing a `VerificationKey` ALWAYS validates in both layers
+(decompression may fail -> MalformedPublicKey), exactly like the
+reference bridge; `VerificationKeyBytes` stays unvalidated by design
+(L1 validation-deferral invariant, SURVEY.md §1).
 """
 
 import json
@@ -84,3 +101,66 @@ def from_json(s: str):
     if tag not in _TYPES:
         raise ValueError(f"unknown type tag {tag!r}")
     return from_hex(_TYPES[tag], doc["bytes"])
+
+
+# -- reference-compatible human-readable layout ---------------------------
+
+
+def to_ref_value(obj):
+    """The JSON-ready value the reference's serde derives emit for `obj`
+    through a human-readable serializer (see module docstring for the
+    per-type layouts and reference file:line cites)."""
+    if isinstance(obj, Signature):
+        return {
+            "R_bytes": list(obj.R_bytes),
+            "s_bytes": list(obj.s_bytes),
+        }
+    if isinstance(obj, (VerificationKey, VerificationKeyBytes, SigningKey)):
+        # newtype [u8;32] / 64-tuple expanded secret key: bare int array
+        return list(obj.to_bytes())
+    raise TypeError(f"not a serializable ed25519 type: {type(obj)!r}")
+
+
+def _ref_bytes(value, n: int, what: str) -> bytes:
+    if (
+        not isinstance(value, list)
+        or len(value) != n
+        or not all(isinstance(b, int) and not isinstance(b, bool)
+                   and 0 <= b <= 255 for b in value)
+    ):
+        raise ValueError(f"expected a {n}-element byte array for {what}")
+    return bytes(value)
+
+
+def from_ref_value(cls, value):
+    """Parse `cls` from the reference's derived human-readable layout
+    (inverse of `to_ref_value`).  `VerificationKey` validates on
+    deserialize (reference try_from bridge); `SigningKey` takes the
+    64-byte expanded form only, exactly like the reference's tuple
+    visitor (src/signing_key.rs:48-78)."""
+    if cls is Signature:
+        if not isinstance(value, dict) or set(value) != {
+            "R_bytes", "s_bytes",
+        }:
+            raise ValueError(
+                "expected a {'R_bytes','s_bytes'} object for Signature")
+        return Signature(
+            _ref_bytes(value["R_bytes"], 32, "Signature.R_bytes"),
+            _ref_bytes(value["s_bytes"], 32, "Signature.s_bytes"),
+        )
+    if cls in (VerificationKey, VerificationKeyBytes):
+        return cls.from_bytes(_ref_bytes(value, 32, cls.__name__))
+    if cls is SigningKey:
+        return cls.from_bytes(_ref_bytes(value, 64, "SigningKey"))
+    raise TypeError(f"not a serializable ed25519 type: {cls!r}")
+
+
+def to_ref_json(obj) -> str:
+    """Reference-compatible JSON text (what serde_json emits from the
+    reference's derives)."""
+    return json.dumps(to_ref_value(obj), separators=(",", ":"))
+
+
+def from_ref_json(cls, s: str):
+    """Parse `cls` from reference-compatible JSON text."""
+    return from_ref_value(cls, json.loads(s))

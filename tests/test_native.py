@@ -20,6 +20,22 @@ def test_native_library_builds():
     assert native.load() is not None
 
 
+def test_disable_native_env_is_not_latched(monkeypatch):
+    """ED25519_TPU_DISABLE_NATIVE is re-checked per load() call: setting
+    it must not latch _lib_failed (a disable is not a failure), and
+    unsetting it mid-process re-enables the library (ADVICE r3)."""
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("ED25519_TPU_DISABLE_NATIVE", "1")
+    assert native.load() is None
+    assert not native._lib_failed
+    monkeypatch.setenv("ED25519_TPU_DISABLE_NATIVE", "false")
+    assert native.load() is lib  # explicit opt-outs only
+    monkeypatch.delenv("ED25519_TPU_DISABLE_NATIVE")
+    assert native.load() is lib
+
+
 def test_decompress_parity():
     encs = [p.compress() for p in edwards.eight_torsion()]
     encs += fixtures.non_canonical_point_encodings()

@@ -75,11 +75,11 @@ def test_multiblock_interpret_kernel_parity():
 
     Infrastructure note: interpret=True lowers to plain XLA ops.  The
     rolled kernel body traces/compiles in ~1 min even on the true cpu
-    backend, so cpu-only hosts get real coverage; the legacy unrolled
-    body (~80k-op graph; 10-25 min cpu compile, measured) is additionally
-    pinned when an accelerator is attached (remote compile ~1-2 min).
-    Runs in a clean subprocess so the backend choice can differ from the
-    suite's forced-cpu config."""
+    backend, so cpu-only hosts get real coverage; the hybrid
+    (unrolled-windows) body is additionally pinned when an accelerator
+    is attached (remote compile ~1-2 min).  Runs in a clean subprocess
+    so the backend choice can differ from the suite's forced-cpu
+    config."""
     import os
     import subprocess
     import sys

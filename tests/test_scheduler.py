@@ -232,6 +232,61 @@ def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
     assert batch.verify_many(vs2, rng=rng, merge="never") == expected(4)
 
 
+def test_unresolved_probe_streak_arms_backoff(monkeypatch):
+    """VERDICT r3 #4: a probe that never RESOLVES (here: errors every
+    call, so the device is never measured) must stop being re-paid on
+    every verify_many call — after _UNRESOLVED_PROBE_LIMIT consecutive
+    unresolved probes a shorter re-probe backoff arms, and the next call
+    skips the device lane entirely."""
+    warm_kernel_cache()
+    calls = []
+
+    def boom(digits, pts):
+        calls.append(digits.shape[0])
+        raise RuntimeError("probe never yields a measurement")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
+    t0 = time.monotonic()
+    for i in range(batch._UNRESOLVED_PROBE_LIMIT):
+        vs = make_verifiers(8, bad={1})
+        assert batch.verify_many(vs, rng=rng, chunk=2,
+                                 merge="never") == expected(8, bad={1})
+        stats = batch.last_run_stats
+        assert stats["probed"] and not stats["device_measured"]
+        assert stats["host_batches"] == 8
+        assert batch._unresolved_probe_streak[0] == i + 1
+    # limit reached: the shorter backoff is armed…
+    assert batch._device_uncompetitive_until[0] > t0
+    # …and the next call must not touch the device lane at all
+    n_probes = len(calls)
+
+    def fail_get(cls, mesh=0):
+        raise AssertionError("probed during unresolved-probe backoff")
+
+    monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
+    vs = make_verifiers(8)
+    assert batch.verify_many(vs, rng=rng, chunk=2,
+                             merge="never") == expected(8)
+    assert len(calls) == n_probes  # no new probe paid
+    assert not batch.last_run_stats["probed"]
+    # reset_device_health clears the streak with the rest of the state
+    batch.reset_device_health()
+    assert batch._unresolved_probe_streak[0] == 0
+
+
+def test_measured_probe_resets_unresolved_streak(monkeypatch):
+    """A probe that DOES resolve (measured EMA) must clear the unresolved
+    streak — only consecutive unresolved probes arm the backoff."""
+    warm_kernel_cache()
+    batch._unresolved_probe_streak[0] = batch._UNRESOLVED_PROBE_LIMIT - 1
+    vs = make_verifiers(4)
+    assert batch.verify_many(vs, rng=rng, chunk=2,
+                             merge="never") == expected(4)
+    assert batch.last_run_stats["device_measured"] or \
+        batch.last_run_stats["device_batches"]
+    assert batch._unresolved_probe_streak[0] == 0
+
+
 def test_host_overtake_discards_inflight_chunk(monkeypatch):
     """When the pool drains while a chunk is in flight, the host races it;
     a fully-overtaken chunk is discarded (its late result is dropped)."""

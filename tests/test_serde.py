@@ -97,6 +97,56 @@ def test_serde_error_paths():
         serde.from_json('{"type": 3, "bytes": ""}')
 
 
+def test_ref_layout_round_trips_all_types():
+    """The reference-compatible layer emits exactly what the reference's
+    serde derives produce through serde_json: Signature as the derived
+    two-field struct of int arrays (src/signature.rs:6-11), keys as a
+    bare 32-int array (newtype derive, src/verification_key.rs:33),
+    SigningKey as the 64-int expanded tuple (src/signing_key.rs:31-78)."""
+    import json
+
+    sk, vk, sig = _fresh()
+    v = serde.to_ref_value(sig)
+    assert set(v) == {"R_bytes", "s_bytes"}
+    assert v["R_bytes"] == list(sig.R_bytes) and len(v["R_bytes"]) == 32
+    assert serde.from_ref_value(Signature, v) == sig
+    for obj, cls in ((vk.A_bytes, VerificationKeyBytes),
+                     (vk, VerificationKey)):
+        v = serde.to_ref_value(obj)
+        assert v == list(obj.to_bytes())  # bare 32-int array
+        assert serde.from_ref_value(cls, v) == obj
+    v = serde.to_ref_value(sk)
+    assert len(v) == 64  # expanded secret key tuple
+    assert serde.from_ref_value(SigningKey, v).to_bytes() == sk.to_bytes()
+    # JSON text round trip + shape check
+    doc = serde.to_ref_json(sig)
+    assert json.loads(doc)["s_bytes"] == list(sig.s_bytes)
+    assert serde.from_ref_json(Signature, doc) == sig
+
+
+def test_ref_layout_validates_and_rejects():
+    # VerificationKey validates on deserialize (try_from bridge)…
+    bad = list((2).to_bytes(32, "little"))
+    assert serde.from_ref_value(VerificationKeyBytes, bad) is not None
+    with pytest.raises(MalformedPublicKey):
+        serde.from_ref_value(VerificationKey, bad)
+    # …SigningKey takes ONLY the 64-byte expanded form (the reference
+    # tuple visitor reads exactly 64 elements)…
+    with pytest.raises(ValueError):
+        serde.from_ref_value(SigningKey, list(range(32)))
+    # …and malformed arrays/objects surface as ValueError
+    with pytest.raises(ValueError):
+        serde.from_ref_value(VerificationKeyBytes, [256] * 32)
+    with pytest.raises(ValueError):
+        serde.from_ref_value(VerificationKeyBytes, [0] * 31)
+    with pytest.raises(ValueError):
+        serde.from_ref_value(Signature, {"R_bytes": [0] * 32})
+    with pytest.raises(TypeError):
+        serde.to_ref_value(b"raw bytes are not a typed object")
+    with pytest.raises(TypeError):
+        serde.from_ref_value(bytes, [0] * 32)
+
+
 def test_verification_key_total_order_forwards_to_bytes():
     rng = random.Random(11)
     vks = [SigningKey.new(rng).verification_key() for _ in range(12)]

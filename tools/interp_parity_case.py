@@ -31,9 +31,10 @@ def main():
     backend = jax.devices()[0].platform
     # Which kernel bodies to pin: the rolled body's interpret graph
     # compiles in ~1 min even on the true cpu backend, so cpu-only hosts
-    # get real coverage now; the legacy unrolled body stays
-    # accelerator-only (its ~80k-op graph is a 10-25 min cpu compile).
-    bodies = ("rolled",) if backend == "cpu" else ("rolled", "unrolled")
+    # get real coverage; accelerators also pin the hybrid
+    # (unrolled-windows) body.  The legacy list-of-tiles body was
+    # removed in round 4 (could no longer compile at production shape).
+    bodies = ("rolled",) if backend == "cpu" else ("rolled", "hybrid")
     rng = random.Random(0x1417)
     tile = (1, 128)
     group = tile[0] * tile[1]

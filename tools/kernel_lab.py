@@ -128,9 +128,16 @@ def exp_variant(name, **kw):
           flush=True)
 
 
+_EXPS = ("baseline", "all", "i32", "i32big", "s8", "s8i32", "s16",
+         "all8", "w3", "w11", "w11i32", "allw", "rolled", "hybrid",
+         "ab", "rolledB8")
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--exp", default="baseline")
+    # choices= so a stale experiment name (e.g. the removed "unrolled"
+    # body A/B) errors loudly instead of silently running nothing
+    ap.add_argument("--exp", default="baseline", choices=_EXPS)
     args = ap.parse_args()
     import jax
 
@@ -155,12 +162,13 @@ def main():
         exp_variant("winchunk11-i32-G2048", tile=(16, 128),
                     tbl_dtype="int32", win_chunk=11)
     if args.exp in ("rolled", "ab"):
-        # round-3 rolled body: first-call time here IS the cold-start
-        # number (trace seconds, not minutes); slope vs the unrolled body
-        # is the runtime A/B
+        # rolled body: first-call time here IS the cold-start number
+        # (trace seconds, not minutes); slope vs the hybrid body is the
+        # runtime A/B (the legacy list-of-tiles body was removed in r4 —
+        # it stopped compiling at the production B=8 shape)
         exp_variant("rolled-w11", body="rolled", win_chunk=11)
-    if args.exp in ("unrolled", "ab"):
-        exp_variant("unrolled-w11", body="unrolled", win_chunk=11)
+    if args.exp in ("hybrid", "ab"):
+        exp_variant("hybrid-w3", body="hybrid", win_chunk=3)
     if args.exp in ("rolledB8",):
         # production dispatch shape: 8 stacked batches
         from ed25519_consensus_tpu.ops import pallas_msm

@@ -69,23 +69,23 @@ def probe_chain(op: str, tile=(32, 128), n_steps=(64, 512)):
 
 
 def probe_fmul(tile=(32, 128), n_steps=(1, 8)):
-    """Chain of full _fmul schoolbook products (field muls)."""
+    """Chain of full _fmul_a schoolbook products (field muls, array
+    representation — the shipped rolled/hybrid bodies' field op)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    from ed25519_consensus_tpu.ops.pallas_msm import _fmul, NLIMBS
+    from ed25519_consensus_tpu.ops.pallas_msm import _fmul_a, NLIMBS
 
     S, L = tile
 
     def make(n):
         def kernel(x_ref, o_ref):
-            a = [x_ref[i] for i in range(NLIMBS)]
-            b = [x_ref[i] + 1 for i in range(NLIMBS)]
+            a = x_ref[...]
+            b = x_ref[...] + 1
             for _ in range(n):
-                a, b = b, _fmul(a, b)
-            for i in range(NLIMBS):
-                o_ref[i] = b[i]
+                a, b = b, _fmul_a(a, b)
+            o_ref[...] = b
 
         return pl.pallas_call(
             kernel,
