@@ -100,11 +100,27 @@ def load():
             ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.stage_scalars.restype = ctypes.c_int
+        lib.stage_scalars_gid.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.stage_scalars_gid.restype = ctypes.c_int
+        lib.verify_host_gid.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.verify_host_gid.restype = ctypes.c_int
         lib.bulk_challenges.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_char_p,
         ]
         lib.bulk_challenges.restype = None
+        lib.msm_prof.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        lib.msm_prof.restype = None
+        lib.msm_prof_reset.argtypes = []
+        lib.msm_prof_reset.restype = None
         _self_check(lib)
         _lib = lib
     except Exception:
@@ -302,6 +318,66 @@ def stage_scalars(s_blob: bytes, k_blob: bytes, z_blob: bytes, n: int,
     return b_acc, a_accs
 
 
+def _cbuf(b):
+    """ctypes argument from any contiguous byte-like, zero-copy for
+    writable buffers (bytearray, array.array)."""
+    if isinstance(b, bytes):
+        return b
+    return (ctypes.c_char * (len(b) * getattr(b, "itemsize", 1)))\
+        .from_buffer(b)
+
+
+def stage_scalars_gid(s_buf, k_buf, z_blob, n: int,
+                      gid_buf, m: int) -> "tuple | None":
+    """Queue-order native scalar staging: like `stage_scalars` but the
+    per-signature buffers stay in ARRIVAL order and `gid_buf` (n int32
+    group ids) routes each Σz·k contribution to its key's accumulator —
+    no group-contiguous regrouping anywhere.  Buffers may be any
+    contiguous byte-like (bytearray/memoryview accepted zero-copy).
+    Returns (B_acc, [A_acc_g...]) ints, None if some s ≥ ℓ,
+    NotImplemented without the native library."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    b_out = ctypes.create_string_buffer(56)
+    a_out = ctypes.create_string_buffer(56 * m)
+    ok = lib.stage_scalars_gid(
+        _cbuf(s_buf), _cbuf(k_buf), _cbuf(z_blob), n,
+        _cbuf(gid_buf), m, b_out, a_out)
+    if not ok:
+        return None
+    b_acc = int.from_bytes(b_out.raw, "little")
+    araw = a_out.raw  # one copy — .raw re-copies per access
+    a_accs = [
+        int.from_bytes(araw[56 * g: 56 * (g + 1)], "little")
+        for g in range(m)
+    ]
+    return b_acc, a_accs
+
+
+def verify_host_batch(key_rows, r_buf, s_buf, k_buf, z_blob, n: int,
+                      gid_buf, m: int, b_row: bytes):
+    """ONE native call for the whole host batch verification over the
+    queue-order staging buffers: ZIP215 R decompression, s < ℓ checks,
+    gid-routed coalescing, mod-ℓ coefficient reduction, the fused-block
+    MSM, and the cofactored identity check (the reference
+    src/batch.rs:149-217 hot path end-to-end).  `key_rows` are the keys'
+    RAW decompressed 128-byte rows (batch.py caches them per key —
+    consensus streams re-see the same validator set every batch).
+    Returns True/False for the batch verdict, None when staging rejects
+    (bad R encoding or s ≥ ℓ), NotImplemented without the native
+    library."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    res = lib.verify_host_gid(
+        _cbuf(key_rows), _cbuf(r_buf), _cbuf(s_buf), _cbuf(k_buf),
+        _cbuf(z_blob), n, _cbuf(gid_buf), m, b_row)
+    if res < 0:
+        return None
+    return bool(res)
+
+
 def _bulk_challenges_raw(lib, ra_blob: bytes, msgs, raw: bool = False):
     import numpy as np
 
@@ -417,6 +493,31 @@ def vartime_msm_scblob(sblob: bytes, raw_points):
         sblob, pts.ctypes.data_as(ctypes.c_char_p), n, out
     )
     return point_from_raw(out.raw)
+
+
+def msm_profile() -> "dict | None":
+    """Cumulative rdtsc cycle counters per native-MSM phase (table build,
+    window accumulation, Horner combine) plus call/term totals — the
+    machine-speed-invariant phase breakdown on this ±25% shared node
+    (BASELINE.md methodology).  None without the native library."""
+    lib = load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint64 * 5)()
+    lib.msm_prof(out)
+    return {
+        "tbl_cycles": int(out[0]),
+        "acc_cycles": int(out[1]),
+        "horner_cycles": int(out[2]),
+        "calls": int(out[3]),
+        "terms": int(out[4]),
+    }
+
+
+def msm_profile_reset() -> None:
+    lib = load()
+    if lib is not None:
+        lib.msm_prof_reset()
 
 
 def check_prehashed(minus_A, R, k: int, s: int) -> bool:

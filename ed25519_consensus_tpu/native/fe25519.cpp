@@ -13,6 +13,7 @@
 // Plain C ABI (loaded with ctypes; no pybind11 in this environment).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <immintrin.h>
 
@@ -302,26 +303,41 @@ IFMA_TARGET static inline __m512i mul19(__m512i x) {
         x);
 }
 
+// ONE serial carry pass (round 4; was 2).  The working invariant for
+// every fe8 value is `limb < 2^52` — exactly what vpmadd52 requires of
+// its operands — and a single pass restores it from every producer's
+// output bounds:
+//   * fe8_mul fold columns: ≤ 20·(2^52-1) + 19·15·2^52 < 2^60.2 → carries
+//     c ≤ 2^9.2, limbs ≤ 2^51-1 + 2^9.3 (limb 0: +19·c4 ≤ 2^51+2^13.5);
+//   * fe8_add: sums < 2^53 → c ≤ 4;
+//   * fe8_sub / masked Niels negation: a + 4p-bias - b < 2^53.6 → c ≤ 13.
+// All results stay < 2^51 + 2^13.5 « 2^52.  fe8_freeze remains correct on
+// such inputs: its add-19 q-chain propagates the full excess (each stage
+// (h_i + q) >> 51 ≤ 1 since h_i < 2^52), so q ∈ {0,1} and the bit-255
+// discard is exact (h < 2p holds because h < (2^51 + 2^13.5)·Σ2^51i
+// < 2^255 + 2^218).  Parity stays pinned by tests/test_native.py over the
+// full conformance fixtures and an ASan sweep (BASELINE.md).  The second
+// pass was pure conservatism: carry work is ~30 instructions/pass and
+// runs inside EVERY fe8 op — dropping it cuts the decompression chain,
+// the table build, and the window accumulation together.
 IFMA_TARGET static inline void fe8_carry(fe8 &h) {
     const __m512i mask = _mm512_set1_epi64(MASK51);
-    for (int pass = 0; pass < 2; pass++) {
-        __m512i c;
-        c = _mm512_srli_epi64(h.v[0], 51);
-        h.v[0] = _mm512_and_si512(h.v[0], mask);
-        h.v[1] = _mm512_add_epi64(h.v[1], c);
-        c = _mm512_srli_epi64(h.v[1], 51);
-        h.v[1] = _mm512_and_si512(h.v[1], mask);
-        h.v[2] = _mm512_add_epi64(h.v[2], c);
-        c = _mm512_srli_epi64(h.v[2], 51);
-        h.v[2] = _mm512_and_si512(h.v[2], mask);
-        h.v[3] = _mm512_add_epi64(h.v[3], c);
-        c = _mm512_srli_epi64(h.v[3], 51);
-        h.v[3] = _mm512_and_si512(h.v[3], mask);
-        h.v[4] = _mm512_add_epi64(h.v[4], c);
-        c = _mm512_srli_epi64(h.v[4], 51);
-        h.v[4] = _mm512_and_si512(h.v[4], mask);
-        h.v[0] = _mm512_add_epi64(h.v[0], mul19(c));
-    }
+    __m512i c;
+    c = _mm512_srli_epi64(h.v[0], 51);
+    h.v[0] = _mm512_and_si512(h.v[0], mask);
+    h.v[1] = _mm512_add_epi64(h.v[1], c);
+    c = _mm512_srli_epi64(h.v[1], 51);
+    h.v[1] = _mm512_and_si512(h.v[1], mask);
+    h.v[2] = _mm512_add_epi64(h.v[2], c);
+    c = _mm512_srli_epi64(h.v[2], 51);
+    h.v[2] = _mm512_and_si512(h.v[2], mask);
+    h.v[3] = _mm512_add_epi64(h.v[3], c);
+    c = _mm512_srli_epi64(h.v[3], 51);
+    h.v[3] = _mm512_and_si512(h.v[3], mask);
+    h.v[4] = _mm512_add_epi64(h.v[4], c);
+    c = _mm512_srli_epi64(h.v[4], 51);
+    h.v[4] = _mm512_and_si512(h.v[4], mask);
+    h.v[0] = _mm512_add_epi64(h.v[0], mul19(c));
 }
 
 IFMA_TARGET static void fe8_mul(fe8 &out, const fe8 &a, const fe8 &b) {
@@ -654,10 +670,19 @@ struct ge8 {
 // Signed radix-16 Straus (round 3): digits d ∈ [-8, 8] need only a
 // 9-entry multiples table ([0..8]P in Niels form) — half the chained
 // table-build additions of the unsigned 16-entry scheme AND a 1.8×
-// smaller gather footprint (1440 B/term vs 2560), at the cost of one
+// smaller lookup footprint (1440 B/term vs 2560), at the cost of one
 // extra carry window (65 instead of 64) and a masked Niels negation in
-// the gather path.  Table build measured at 56% of the whole MSM on the
-// unsigned scheme, so this is the single biggest host-MSM lever.
+// the select path.  Table build measured at 56% of the whole MSM on the
+// unsigned scheme, so this was the single biggest host-MSM lever.
+//
+// Table layout (round 4): PLANE-MAJOR per term — for each (coord, limb)
+// the 9 entries' u64s are consecutive:
+//     u64 offset = (coord·5 + limb)·9 + entry.
+// This turns the accumulation's per-(coord,limb) 8-lane entry select
+// from a vpgatherqq (~20+ cycles even L1-hit; the round-3 layout's
+// accumulate profiled ~2.9k cycles/term with gathers ~dominant) into
+// one 64-byte load of entries 0..7 + a broadcast of entry 8 + a single
+// vpermi2q keyed by the |digit| lanes (1/cycle throughput).
 static const int TBL_ENTRIES = 9;          // [0]..[8]  (Niels form)
 static const int TBL_STRIDE = TBL_ENTRIES * 20;   // u64s per term
 static const int NDIG = 65;                // 64 nibbles + signed carry
@@ -751,7 +776,8 @@ IFMA_TARGET static void table_build8(const uint8_t *points, u64 *tables) {
 
     auto store_entry = [&](int k, const ge8 &e) {
         // store in Niels form: (Y-X, Y+X, 2Z, T*2d); ONE scatter per
-        // (coord, limb) replaces 8 scalar transpose stores
+        // (coord, limb) replaces 8 scalar transpose stores.  Plane-major
+        // layout: entry k of plane (c, i) lives at (c·5+i)·9 + k.
         fe8 n[4];
         fe8_sub(n[0], e.Y, e.X);
         fe8_add(n[1], e.Y, e.X);
@@ -760,17 +786,17 @@ IFMA_TARGET static void table_build8(const uint8_t *points, u64 *tables) {
         for (int c = 0; c < 4; c++)
             for (int i = 0; i < 5; i++)
                 _mm512_i64scatter_epi64(
-                    (void *)(tables + 20 * k + 5 * c + i), lane_off,
+                    (void *)(tables + (5 * c + i) * 9 + k), lane_off,
                     n[c].v[i], 8);
     };
 
     for (int l = 0; l < 8; l++) {
-        // Niels identity: (1, 1, 2, 0)
+        // Niels identity (1, 1, 2, 0) at entry 0 of each plane
         u64 *row = tables + TBL_STRIDE * l;
-        memset(row, 0, 160);
-        row[0] = 1;
-        row[5] = 1;
-        row[10] = 2;
+        memset(row, 0, TBL_STRIDE * 8);
+        row[0 * 9] = 1;
+        row[5 * 9] = 1;
+        row[10 * 9] = 2;
     }
     ge8 e = p;
     store_entry(1, e);
@@ -810,7 +836,7 @@ IFMA_TARGET static void table_build8_x2(const uint8_t *points,
 
     auto store_entry = [&](int half, int k, const ge8 &e) {
         // store in Niels form: (Y-X, Y+X, 2Z, T*2d); one scatter per
-        // (coord, limb) — see table_build8
+        // (coord, limb), plane-major — see table_build8
         u64 *tbl = tables + TBL_STRIDE * 8 * half;
         fe8 n[4];
         fe8_sub(n[0], e.Y, e.X);
@@ -820,17 +846,17 @@ IFMA_TARGET static void table_build8_x2(const uint8_t *points,
         for (int c = 0; c < 4; c++)
             for (int i = 0; i < 5; i++)
                 _mm512_i64scatter_epi64(
-                    (void *)(tbl + 20 * k + 5 * c + i), lane_off,
+                    (void *)(tbl + (5 * c + i) * 9 + k), lane_off,
                     n[c].v[i], 8);
     };
 
     for (int l = 0; l < 16; l++) {
-        // Niels identity: (1, 1, 2, 0)
+        // Niels identity (1, 1, 2, 0) at entry 0 of each plane
         u64 *row = tables + TBL_STRIDE * l;
-        memset(row, 0, 160);
-        row[0] = 1;
-        row[5] = 1;
-        row[10] = 2;
+        memset(row, 0, TBL_STRIDE * 8);
+        row[0 * 9] = 1;
+        row[5 * 9] = 1;
+        row[10 * 9] = 2;
     }
     ge8 ea = pa, eb = pb;
     store_entry(0, 1, ea);
@@ -843,92 +869,64 @@ IFMA_TARGET static void table_build8_x2(const uint8_t *points,
     }
 }
 
-// Accumulate the 65 per-window signed-Straus sums over all n terms.
-// `tables` is the scalar layout: per term, TBL_ENTRIES entries ([0..8]P
-// in Niels form) × (Y-X, Y+X, 2Z, 2dT) × 5 u64 limbs contiguous (u64
-// element offset = |digit|·20 + coord·5 + limb).  Negative digits gather
-// |d| and negate in Niels form (swap Y-X/Y+X, negate 2dT) under a lane
-// mask.  `sums` receives the 72 window sums (window w = 8·group + lane;
-// only w ≤ 64 can be non-identity) in the 20-u64 point layout.
-IFMA_TARGET static void straus_accumulate8(const u64 *tables,
-                                           const uint8_t *scalars,
-                                           uint64_t n, u64 *sums) {
-    // Grow-only holder, INTENTIONALLY immortal: a thread_local
-    // destructor here runs during process/thread teardown interleaved
-    // with the embedding runtime's own exit handlers — measured as a
-    // SIGSEGV at pytest exit when it freed these buffers — so the
-    // per-thread allocation is deliberately left to the OS at exit.
-    // The pointer is nulled BEFORE the grow `new` so a bad_alloc can't
-    // leave a dangling pointer that a retry would double-free.
-    struct digs_holder {
-        int8_t *p = nullptr;
-        uint64_t cap = 0;
-    };
-    static thread_local digs_holder db;
-    if (db.cap < NDIG_PAD * n) {
-        delete[] db.p;
-        db.p = nullptr;
-        db.cap = 0;
-        db.p = new int8_t[NDIG_PAD * n];
-        db.cap = NDIG_PAD * n;
-    }
-    int8_t *digs = db.p;
-    fe8 d2;
-    fe8_splat(d2, FE_2D);
-    const int NG = NDIG_PAD / 8;  // 9 window groups
-    ge8 acc[NG];
+// Persistent accumulation state for the FUSED block MSM (round 4): the
+// 65 live signed-window sums (72 slots) held as two 8-lane accumulator
+// sets — even/odd terms alternate between them to halve the
+// add-dependency chain per window group — that survive ACROSS blocks,
+// so the multiples tables only ever need to exist one small block at a
+// time (cache-hot between build and accumulate; round 3's whole-batch
+// table pass streamed 14+ MB through L2 between the two phases, and the
+// accumulate gathers measured L2-bound at 34M cycles/10k terms).
+static const int NG = NDIG_PAD / 8;  // 9 window groups
+
+struct straus_ctx {
+    ge8 acc[NG], acc2[NG];
+};
+
+IFMA_TARGET static void straus_ctx_init(straus_ctx &ctx) {
     const __m512i zero = _mm512_setzero_si512();
     const __m512i one = _mm512_set1_epi64(1);
     for (int g = 0; g < NG; g++) {
         for (int i = 0; i < 5; i++) {
-            acc[g].X.v[i] = zero;
-            acc[g].Y.v[i] = i == 0 ? one : zero;
-            acc[g].Z.v[i] = i == 0 ? one : zero;
-            acc[g].T.v[i] = zero;
+            ctx.acc[g].X.v[i] = zero;
+            ctx.acc[g].Y.v[i] = i == 0 ? one : zero;
+            ctx.acc[g].Z.v[i] = i == 0 ? one : zero;
+            ctx.acc[g].T.v[i] = zero;
+            ctx.acc2[g].X.v[i] = zero;
+            ctx.acc2[g].Y.v[i] = i == 0 ? one : zero;
+            ctx.acc2[g].Z.v[i] = i == 0 ? one : zero;
+            ctx.acc2[g].T.v[i] = zero;
         }
     }
-    // Two accumulator sets (even/odd terms) halve the add-dependency
-    // chains per window group; they are folded together at the end.
-    ge8 acc2[NG];
-    for (int g = 0; g < NG; g++) {
-        for (int i = 0; i < 5; i++) {
-            acc2[g].X.v[i] = zero;
-            acc2[g].Y.v[i] = i == 0 ? one : zero;
-            acc2[g].Z.v[i] = i == 0 ? one : zero;
-            acc2[g].T.v[i] = zero;
-        }
-    }
-    // One recoding pass up front (cheap, linear) so the prefetcher can
-    // read the NEXT term's signed digits.
-    for (uint64_t t = 0; t < n; t++)
-        recode_signed64(scalars + 32 * t, digs + NDIG_PAD * t);
+}
+
+// Accumulate one BLOCK of n terms into the running per-window sums.
+// `tables` is the block's scalar layout: per term, TBL_ENTRIES entries
+// ([0..8]P in Niels form) × (Y-X, Y+X, 2Z, 2dT) × 5 u64 limbs contiguous
+// (u64 element offset = |digit|·20 + coord·5 + limb).  `digs` is the
+// block's pre-recoded signed digits (NDIG_PAD per term).  `t_base`
+// carries the global term parity so the even/odd accumulator
+// alternation stays balanced across blocks.  Negative digits gather |d|
+// and negate in Niels form (swap Y-X/Y+X, negate 2dT) under a lane
+// mask.
+IFMA_TARGET static void straus_accumulate8_block(const u64 *tables,
+                                                 const int8_t *digs,
+                                                 uint64_t n,
+                                                 uint64_t t_base,
+                                                 straus_ctx &ctx) {
     // 4p per limb (radix-51; 0xFFFFFFFFFFFDA is already the 2p limb):
     // for the masked Niels negation 4p - x, matching fe8_sub's bias
     // convention and bounds.
     const __m512i p2_0 = _mm512_set1_epi64(0xFFFFFFFFFFFDAULL * 2);
     const __m512i p2_i = _mm512_set1_epi64(0xFFFFFFFFFFFFEULL * 2);
-    const __m512i twenty = _mm512_set1_epi64(20);
     for (uint64_t t = 0; t < n; t++) {
-        ge8 *accs = (t & 1) ? acc2 : acc;
+        ge8 *accs = ((t_base + t) & 1) ? ctx.acc2 : ctx.acc;
         const u64 *base = tables + TBL_STRIDE * t;
         const int8_t *dig = digs + NDIG_PAD * t;
-        // Prefetch the table entries the NEXT term's low 32 windows will
-        // gather.  Only the low half on purpose: the 128-bit blinder
-        // terms that dominate a staged batch have (almost) no digits
-        // above window 32 (see the ngroups skip below), so prefetching
-        // the high half would double hint traffic for no common-case
-        // gain.
-        if (t + 1 < n) {
-            const u64 *nbase = tables + TBL_STRIDE * (t + 1);
-            const int8_t *nd = digs + NDIG_PAD * (t + 1);
-            for (int w = 0; w < 32; w++) {
-                int d = nd[w] < 0 ? -nd[w] : nd[w];
-                const char *line = (const char *)(nbase + 20 * d);
-                _mm_prefetch(line, _MM_HINT_T0);
-                _mm_prefetch(line + 64, _MM_HINT_T0);
-                _mm_prefetch(line + 128, _MM_HINT_T0);
-            }
-        }
+        // No table prefetch: the fused block structure (ifma_msm) built
+        // this block's tables immediately before this call, so they are
+        // already L1/L2-hot — the round-3 per-digit prefetch burst was
+        // measured cost-neutral-to-negative here and removed.
         // Skip all-zero window groups: the 128-bit blinder terms that
         // dominate a staged batch populate only groups 0..4 (and group
         // 4 only via the signed carry digit about half the time).
@@ -948,17 +946,19 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
                 negm |= (__mmask8)((d[l] < 0) << l);
                 ad[l] = d[l] < 0 ? -d[l] : d[l];
             }
-            __m512i idx = _mm512_mullo_epi64(
-                _mm512_set_epi64(ad[7], ad[6], ad[5], ad[4], ad[3],
-                                 ad[2], ad[1], ad[0]),
-                twenty);
+            // |digit| ∈ [0, 8] selects among the 9 plane entries: one
+            // vpermi2q over (entries 0..7, broadcast entry 8) per
+            // (coord, limb) — no gathers in the hot loop.
+            __m512i idx = _mm512_set_epi64(ad[7], ad[6], ad[5], ad[4],
+                                           ad[3], ad[2], ad[1], ad[0]);
             fe8 nc[4];
             for (int c = 0; c < 4; c++) {
                 for (int l = 0; l < 5; l++) {
-                    __m512i off = _mm512_add_epi64(
-                        idx, _mm512_set1_epi64(c * 5 + l));
-                    nc[c].v[l] = _mm512_i64gather_epi64(
-                        off, (const long long *)base, 8);
+                    const u64 *plane = base + (5 * c + l) * 9;
+                    __m512i lo = _mm512_loadu_si512(
+                        (const void *)plane);
+                    __m512i hi = _mm512_set1_epi64(plane[8]);
+                    nc[c].v[l] = _mm512_permutex2var_epi64(lo, idx, hi);
                 }
             }
             if (negm) {
@@ -981,12 +981,20 @@ IFMA_TARGET static void straus_accumulate8(const u64 *tables,
             ge8_add_niels(accs[g], accs[g], nc[0], nc[1], nc[2], nc[3]);
         }
     }
+}
+
+// Fold the two accumulator sets and store the 72 window sums (window
+// w = 8·group + lane; only w ≤ 64 can be non-identity) in the 20-u64
+// point layout.
+IFMA_TARGET static void straus_ctx_extract(straus_ctx &ctx, u64 *sums) {
+    fe8 d2;
+    fe8_splat(d2, FE_2D);
     for (int g = 0; g < NG; g++)
-        ge8_add(acc[g], acc[g], acc2[g], d2);
+        ge8_add(ctx.acc[g], ctx.acc[g], ctx.acc2[g], d2);
     alignas(64) u64 lanes[5][8];
     for (int g = 0; g < NG; g++) {
-        const fe8 *coords[4] = {&acc[g].X, &acc[g].Y, &acc[g].Z,
-                                &acc[g].T};
+        const fe8 *coords[4] = {&ctx.acc[g].X, &ctx.acc[g].Y, &ctx.acc[g].Z,
+                                &ctx.acc[g].T};
         for (int c = 0; c < 4; c++) {
             for (int i = 0; i < 5; i++)
                 _mm512_store_si512((__m512i *)lanes[i],
@@ -1013,9 +1021,43 @@ static bool ifma_available() {
 static bool ifma_available() { return false; }
 #endif  // __x86_64__
 
+// ---- MSM phase profiling (rdtsc) ----------------------------------------
+// Cycle counters per MSM phase, read via msm_prof()/msm_prof_reset().
+// Cycles are machine-speed-invariant on this ±25% shared node (wall times
+// are not), so these are the honest phase comparison across sessions
+// (BASELINE.md round-3 methodology).  Counted per block/call (not per
+// term): overhead is a few dozen rdtsc per MSM — noise.  Plain globals:
+// the host MSM runs on one thread at a time (device-lane worker or main);
+// a torn read under racing callers only perturbs profiling output.
+
+static u64 prof_tbl_cycles = 0;    // multiples-table build
+static u64 prof_acc_cycles = 0;    // window-sum accumulation (gathers)
+static u64 prof_horner_cycles = 0; // serial window combine
+static u64 prof_msm_calls = 0;
+static u64 prof_msm_terms = 0;
+
+#if defined(__x86_64__)
+static inline u64 prof_now() { return __rdtsc(); }
+#else
+static inline u64 prof_now() { return 0; }
+#endif
+
 }  // namespace
 
 extern "C" {
+
+void msm_prof(u64 out[5]) {
+    out[0] = prof_tbl_cycles;
+    out[1] = prof_acc_cycles;
+    out[2] = prof_horner_cycles;
+    out[3] = prof_msm_calls;
+    out[4] = prof_msm_terms;
+}
+
+void msm_prof_reset() {
+    prof_tbl_cycles = prof_acc_cycles = prof_horner_cycles = 0;
+    prof_msm_calls = prof_msm_terms = 0;
+}
 
 // Variable-time multiscalar multiplication: out = Σ [scalar_i] P_i.
 // Straus with shared doublings and per-point radix-16 tables — the native
@@ -1028,22 +1070,13 @@ extern "C" {
 static void edwards_vartime_msm_chunk(const uint8_t *scalars,
                                       const uint8_t *points, uint64_t n,
                                       ge &acc) {
+    // Scalar (non-IFMA) fallback path: unsigned radix-16 Straus with
+    // 16-entry extended-form tables and shared doublings.
     if (n > 0) {
-        bool niels_tables = false;
-#if defined(__x86_64__)
-        // IFMA tables are 9-entry signed-digit Niels form, readable only
-        // by the IFMA accumulation path (n >= 16); otherwise build
-        // 16-entry scalar extended-form tables for the unsigned scalar
-        // Straus loop.
-        niels_tables = ifma_available() && n >= 16;
-#endif
-        const int stride = niels_tables ? 9 : 16;
+        const int stride = 16;
         // per-point tables: T[i][j] = [j] P_i.  Grow-only thread_local
-        // buffer: a fresh 14.5 MB allocation per call costs ~3.5k pages
-        // of first-touch faults (~7M cycles measured); steady-state
-        // batches reuse hot pages.
-        // intentionally immortal — see digs_holder in
-        // straus_accumulate8 for the teardown rationale
+        // buffer, intentionally immortal — see the holders in ifma_msm
+        // for the teardown rationale.
         struct tbl_holder {
             ge *p = nullptr;
             uint64_t cap = 0;
@@ -1057,18 +1090,7 @@ static void edwards_vartime_msm_chunk(const uint8_t *scalars,
             tb.cap = n * stride;
         }
         ge *tables = tb.p;
-        uint64_t i0 = 0;
-#if defined(__x86_64__)
-        if (niels_tables) {
-            for (; i0 + 16 <= n; i0 += 16)
-                ifma::table_build8_x2(points + 128 * i0,
-                                      (u64 *)(tables + stride * i0));
-            for (; i0 + 8 <= n; i0 += 8)
-                ifma::table_build8(points + 128 * i0,
-                                   (u64 *)(tables + stride * i0));
-        }
-#endif
-        for (uint64_t i = i0; i < n; i++) {
+        for (uint64_t i = 0; i < n; i++) {
             ge p;
             ge_frombytes128(p, points + 128 * i);
             ge_identity(tables[stride * i]);
@@ -1076,42 +1098,7 @@ static void edwards_vartime_msm_chunk(const uint8_t *scalars,
             for (int j = 2; j < stride; j++)
                 ge_add(tables[stride * i + j],
                        tables[stride * i + j - 1], p);
-            if (niels_tables) {
-                // Convert this point's entries to the Niels form the
-                // IFMA accumulation reads: (Y-X, Y+X, 2Z, T*2d).
-                for (int j = 0; j < stride; j++) {
-                    ge &e = tables[stride * i + j];
-                    ge nf;
-                    fe_sub(nf.X, e.Y, e.X);
-                    fe_add(nf.Y, e.Y, e.X);
-                    fe_add(nf.Z, e.Z, e.Z);
-                    fe_mul(nf.T, e.T, FE_2D);
-                    e = nf;
-                }
-            }
         }
-#if defined(__x86_64__)
-        if (niels_tables) {
-            // 8-way transposed accumulation: 65 live signed-window sums
-            // (72 slots), then a scalar Horner combine (MSB-first) into a
-            // chunk-local accumulator folded into the running total.
-            u64 *sums = new u64[ifma::NDIG_PAD * 20];
-            ifma::straus_accumulate8((const u64 *)tables, scalars, n,
-                                     sums);
-            ge hacc;
-            ge_identity(hacc);
-            for (int w = 64; w >= 0; w--) {
-                if (w != 64)
-                    for (int k = 0; k < 4; k++) ge_double(hacc, hacc);
-                ge s;
-                memcpy(&s, sums + 20 * w, 160);
-                ge_add(hacc, hacc, s);
-            }
-            ge_add(acc, acc, hacc);
-            delete[] sums;
-            return;
-        }
-#endif
         ge chunk_acc;
         ge_identity(chunk_acc);
         for (int w = 63; w >= 0; w--) {
@@ -1129,22 +1116,151 @@ static void edwards_vartime_msm_chunk(const uint8_t *scalars,
     }
 }
 
-void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
-                         uint64_t n, uint8_t *out) {
-    // Chunk the MSM so each chunk's multiples tables (1440 B/term with
-    // the 9-entry signed scheme — CHUNK was sized for the old 2560 B
-    // unsigned tables, so it is now ~1.7x more conservative than the
-    // cache needs; a larger CHUNK is an untested tuning lever) stay
-    // cache-resident for the gather-heavy accumulation: MSM(all) is just
-    // the Edwards sum of the chunk MSMs.
+#if defined(__x86_64__)
+// Fused-block IFMA MSM (round 4).  Round 3 ran two whole-batch passes —
+// build ALL multiples tables (1440 B/term: 14+ MB at 10k terms), then
+// accumulate over them — so by the time the gather-heavy accumulation
+// read a term's table it had long been evicted from L1/L2 (accumulate
+// measured 34M cycles/10k terms, L2-bound).  Here the per-window
+// accumulators persist across blocks (straus_ctx) and the two phases
+// interleave over small blocks whose tables stay cache-hot between the
+// scatter-stores of the build and the gathers of the accumulate; one
+// Horner combine runs at the very end (vs one per 10240-term chunk).
+// Block size: ED25519_TPU_MSM_FB terms (default 128 ≈ 184 KB of tables —
+// L2-resident with room; read once per process).
+static uint64_t msm_fb() {
+    static uint64_t fb = 0;
+    if (fb == 0) {
+        const char *e = getenv("ED25519_TPU_MSM_FB");
+        long v = e ? atol(e) : 0;
+        fb = (v >= 16 && v <= (1 << 20)) ? (uint64_t)v : 128;
+    }
+    return fb;
+}
+
+static void ifma_msm(const uint8_t *scalars, const uint8_t *points,
+                     uint64_t n, ge &acc) {
+    const uint64_t FB = msm_fb();
+    // Grow-only holders, INTENTIONALLY immortal: a thread_local
+    // destructor here runs during process/thread teardown interleaved
+    // with the embedding runtime's own exit handlers — measured as a
+    // SIGSEGV at pytest exit when it freed these buffers — so the
+    // per-thread allocation is deliberately left to the OS at exit.
+    // The pointer is nulled BEFORE the grow `new` so a bad_alloc can't
+    // leave a dangling pointer that a retry would double-free.
+    struct tbl_holder {
+        u64 *p = nullptr;
+        uint64_t cap = 0;
+    };
+    struct digs_holder {
+        int8_t *p = nullptr;
+        uint64_t cap = 0;
+    };
+    static thread_local tbl_holder tb;
+    static thread_local digs_holder db;
+    if (tb.cap < FB * ifma::TBL_STRIDE) {
+        delete[] tb.p;
+        tb.p = nullptr;
+        tb.cap = 0;
+        tb.p = new u64[FB * ifma::TBL_STRIDE];
+        tb.cap = FB * ifma::TBL_STRIDE;
+    }
+    if (db.cap < FB * ifma::NDIG_PAD) {
+        delete[] db.p;
+        db.p = nullptr;
+        db.cap = 0;
+        db.p = new int8_t[FB * ifma::NDIG_PAD];
+        db.cap = FB * ifma::NDIG_PAD;
+    }
+    u64 *tables = tb.p;
+    ifma::straus_ctx ctx;
+    ifma::straus_ctx_init(ctx);
+    for (uint64_t off = 0; off < n; off += FB) {
+        const uint64_t c = n - off < FB ? n - off : FB;
+        const uint8_t *pts = points + 128 * off;
+        const uint8_t *scs = scalars + 32 * off;
+        u64 t_tbl = prof_now();
+        uint64_t i0 = 0;
+        for (; i0 + 16 <= c; i0 += 16)
+            ifma::table_build8_x2(pts + 128 * i0,
+                                  tables + ifma::TBL_STRIDE * i0);
+        for (; i0 + 8 <= c; i0 += 8)
+            ifma::table_build8(pts + 128 * i0,
+                               tables + ifma::TBL_STRIDE * i0);
+        for (uint64_t i = i0; i < c; i++) {
+            // scalar tail (< 8 terms): build extended entries, convert
+            // to the Niels form the IFMA accumulation reads
+            // ((Y-X, Y+X, 2Z, T*2d)), and write them PLANE-MAJOR:
+            // entry j of plane (coord, limb) at (coord·5+limb)·9 + j.
+            ge p, e[9];
+            ge_frombytes128(p, pts + 128 * i);
+            ge_identity(e[0]);
+            e[1] = p;
+            for (int j = 2; j < 9; j++) ge_add(e[j], e[j - 1], p);
+            u64 *row = tables + ifma::TBL_STRIDE * i;
+            for (int j = 0; j < 9; j++) {
+                ge nf;
+                fe_sub(nf.X, e[j].Y, e[j].X);
+                fe_add(nf.Y, e[j].Y, e[j].X);
+                fe_add(nf.Z, e[j].Z, e[j].Z);
+                fe_mul(nf.T, e[j].T, FE_2D);
+                const fe *coords[4] = {&nf.X, &nf.Y, &nf.Z, &nf.T};
+                for (int cc = 0; cc < 4; cc++)
+                    for (int l = 0; l < 5; l++)
+                        row[(cc * 5 + l) * 9 + j] = coords[cc]->v[l];
+            }
+        }
+        for (uint64_t i = 0; i < c; i++)
+            ifma::recode_signed64(scs + 32 * i,
+                                  db.p + ifma::NDIG_PAD * i);
+        u64 t_acc = prof_now();
+        prof_tbl_cycles += t_acc - t_tbl;
+        ifma::straus_accumulate8_block((const u64 *)tables, db.p, c, off,
+                                       ctx);
+        prof_acc_cycles += prof_now() - t_acc;
+    }
+    u64 t_h = prof_now();
+    alignas(64) u64 sums[ifma::NDIG_PAD * 20];
+    ifma::straus_ctx_extract(ctx, sums);
+    ge hacc;
+    ge_identity(hacc);
+    for (int w = 64; w >= 0; w--) {
+        if (w != 64)
+            for (int k = 0; k < 4; k++) ge_double(hacc, hacc);
+        ge s;
+        memcpy(&s, sums + 20 * w, 160);
+        ge_add(hacc, hacc, s);
+    }
+    ge_add(acc, acc, hacc);
+    prof_horner_cycles += prof_now() - t_h;
+}
+#endif  // __x86_64__
+
+static void msm_into(ge &acc, const uint8_t *scalars,
+                     const uint8_t *points, uint64_t n) {
+    prof_msm_calls += 1;
+    prof_msm_terms += n;
+#if defined(__x86_64__)
+    if (ifma_available() && n >= 16) {
+        ifma_msm(scalars, points, n, acc);
+        return;
+    }
+#endif
+    // Non-IFMA path: chunk so each chunk's 16-entry tables (2560 B/term)
+    // stay cache-resident for the digit lookups.
     const uint64_t CHUNK = 10240;
-    ge acc;
-    ge_identity(acc);
     for (uint64_t off = 0; off < n; off += CHUNK) {
         uint64_t c = n - off < CHUNK ? n - off : CHUNK;
         edwards_vartime_msm_chunk(scalars + 32 * off, points + 128 * off,
                                   c, acc);
     }
+}
+
+void edwards_vartime_msm(const uint8_t *scalars, const uint8_t *points,
+                         uint64_t n, uint8_t *out) {
+    ge acc;
+    ge_identity(acc);
+    msm_into(acc, scalars, points, n);
     ge_tobytes128(out, acc);
 }
 
@@ -1219,6 +1335,50 @@ static inline void sc_muladd(u64 acc[7], const u64 z[2], const u64 x[4]) {
         c >>= 64;
     }
     acc[6] += (u64)c;
+}
+
+// Shared core of the queue-order staging (round 4): signatures in
+// arrival order with a per-signature GROUP ID, accumulating B += z·s
+// and A[gid] += z·k UNREDUCED into 56-byte rows (7 u64s, 8-aligned;
+// load-modify-store — zcash-style streams interleave the groups).
+// Returns 0 if any s ≥ ℓ (ZIP215 rule 2), else 1.
+static int stage_gid_core(const uint8_t *s_bytes, const uint8_t *k_bytes,
+                          const uint8_t *z_bytes, uint64_t n,
+                          const int32_t *gid, uint64_t m,
+                          u64 B_out[7], uint8_t *a_accs /*m*56B*/) {
+    u64 B[7] = {0, 0, 0, 0, 0, 0, 0};
+    memset(a_accs, 0, 56 * m);
+    for (uint64_t i = 0; i < n; i++) {
+        u64 s[4], k[4], z[2], A[7];
+        memcpy(s, s_bytes + 32 * i, 32);
+        memcpy(k, k_bytes + 32 * i, 32);
+        memcpy(z, z_bytes + 16 * i, 16);
+        if (!sc_is_canonical(s)) return 0;
+        sc_muladd(B, z, s);
+        uint8_t *row = a_accs + 56 * (uint64_t)(uint32_t)gid[i];
+        memcpy(A, row, 56);
+        sc_muladd(A, z, k);
+        memcpy(row, A, 56);
+    }
+    memcpy(B_out, B, 56);
+    return 1;
+}
+
+// Queue-order variant of stage_scalars (round 4): the Python layer
+// never re-walks its coalescing map to regroup 32-byte slices per
+// stage — the flat buffers are appended incrementally at queue time
+// (batch.py) and handed over as-is.
+int stage_scalars_gid(const uint8_t *s_bytes, const uint8_t *k_bytes,
+                      const uint8_t *z_bytes, uint64_t n,
+                      const int32_t *gid, uint64_t m,
+                      uint8_t *b_acc_out /*56B*/,
+                      uint8_t *a_accs_out /*m*56B*/) {
+    u64 B[7];
+    if (!stage_gid_core(s_bytes, k_bytes, z_bytes, n, gid, m, B,
+                        a_accs_out))
+        return 0;
+    memcpy(b_acc_out, B, 56);
+    return 1;
 }
 
 int stage_scalars(const uint8_t *s_bytes, const uint8_t *k_bytes,
@@ -1484,6 +1644,108 @@ void bulk_challenges(const uint8_t *ra, const uint8_t *msgs,
         sha512(parts, lens, 3, h);
         sc_reduce_wide(h, k_out + 32 * i);
     }
+}
+
+// (ℓ − b) mod ℓ for a reduced 32-byte scalar b < ℓ.
+static void sc_negate(const uint8_t b[32], uint8_t out[32]) {
+    int nonzero = 0;
+    for (int i = 0; i < 32; i++) nonzero |= b[i];
+    if (!nonzero) {
+        memset(out, 0, 32);
+        return;
+    }
+    int borrow = 0;
+    for (int i = 0; i < 32; i++) {
+        int d = (int)SC_L_BYTES[i] - (int)b[i] - borrow;
+        borrow = d < 0;
+        out[i] = (uint8_t)(d + (borrow << 8));
+    }
+}
+
+// Reduce a 56-byte unreduced accumulator (the Σz·s / Σz·k sums, < 2^384)
+// to a canonical scalar mod ℓ via the wide reducer (64-byte input,
+// zero-padded).
+static void sc_reduce_acc(const uint8_t acc56[56], uint8_t out[32]) {
+    uint8_t wide[64];
+    memcpy(wide, acc56, 56);
+    memset(wide + 56, 0, 8);
+    sc_reduce_wide(wide, out);
+}
+
+// ONE-CALL host batch verification over the queue-order staging buffers
+// (round 4): ZIP215-decompress the R's, stage the scalars (s < ℓ checks
+// + gid-routed coalescing sums), reduce the coefficients mod ℓ, run the
+// fused-block MSM over [B, A_0.., A_m-1, R_0.., R_n-1], and finish with
+// the cofactored identity check — the entire reference
+// batch::Verifier::verify hot path (src/batch.rs:149-217) in one native
+// call.  The four-native-calls-plus-Python-glue version profiled ~2×
+// this cost at reference-bench batch sizes (32 sigs), where per-call
+// ctypes overhead and per-coefficient int round-trips dominated.
+//   key_rows: m RAW 128-byte key rows (group-id order) — the caller
+//             decompresses keys ONCE per process per key (batch.py's
+//             per-key row cache: consensus workloads re-see the same
+//             validator set every batch, so key decompression amortizes
+//             to zero; R's are fresh per signature and decompress here)
+//   rs:    n compressed 32-byte R encodings (arrival order)
+//   s/k/z: flat arrival-order per-signature buffers (32/32/16 bytes)
+//   gid:   n int32 group ids
+//   b_row: 128-byte raw basepoint row (X‖Y‖Z‖T canonical)
+// Returns 1 = batch valid, 0 = equation fails, -1 = rejected in staging
+// (bad R encoding or s ≥ ℓ) — the all-or-nothing semantics either way.
+int verify_host_gid(const uint8_t *key_rows, const uint8_t *rs,
+                    const uint8_t *s_bytes, const uint8_t *k_bytes,
+                    const uint8_t *z_bytes, uint64_t n,
+                    const int32_t *gid, uint64_t m,
+                    const uint8_t *b_row) {
+    const uint64_t total = 1 + m + n;
+    // grow-only scratch, intentionally immortal (see ifma_msm)
+    struct scratch_holder {
+        uint8_t *p = nullptr;
+        uint64_t cap = 0;
+    };
+    static thread_local scratch_holder pts, scs, oks, accs;
+    struct grow {
+        static uint8_t *ensure(scratch_holder &h, uint64_t need) {
+            if (h.cap < need) {
+                delete[] h.p;
+                h.p = nullptr;
+                h.cap = 0;
+                h.p = new uint8_t[need];
+                h.cap = need;
+            }
+            return h.p;
+        }
+    };
+    uint8_t *points = grow::ensure(pts, total * 128);
+    uint8_t *scalars = grow::ensure(scs, total * 32);
+    uint8_t *ok = grow::ensure(oks, n ? n : 1);
+    uint8_t *a_accs = grow::ensure(accs, 56 * (m ? m : 1));
+
+    memcpy(points, b_row, 128);
+    memcpy(points + 128, key_rows, 128 * m);
+    zip215_decompress_batch(rs, n, points + 128 * (1 + m), ok);
+    for (uint64_t i = 0; i < n; i++)
+        if (!ok[i]) return -1;
+
+    u64 B[7];
+    if (!stage_gid_core(s_bytes, k_bytes, z_bytes, n, gid, m, B, a_accs))
+        return -1;
+    uint8_t b_red[32];
+    sc_reduce_acc((const uint8_t *)B, b_red);
+    sc_negate(b_red, scalars);  // coefficient 0: (−Σz·s) mod ℓ
+    for (uint64_t g = 0; g < m; g++)
+        sc_reduce_acc(a_accs + 56 * g, scalars + 32 * (1 + g));
+    memset(scalars + 32 * (1 + m), 0, 32 * n);
+    for (uint64_t i = 0; i < n; i++)
+        memcpy(scalars + 32 * (1 + m + i), z_bytes + 16 * i, 16);
+
+    ge acc;
+    ge_identity(acc);
+    msm_into(acc, scalars, points, total);
+    ge_double(acc, acc);
+    ge_double(acc, acc);
+    ge_double(acc, acc);
+    return (fe_iszero(acc.X) && fe_eq(acc.Y, acc.Z)) ? 1 : 0;
 }
 
 }  // extern "C"

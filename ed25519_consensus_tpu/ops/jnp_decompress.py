@@ -1,0 +1,180 @@
+"""On-device ZIP215 point expansion from the 33-byte compressed wire
+format (round 4) — the transfer-floor attack of VERDICT r3 #1b.
+
+The device lane's H2D bytes were dominated by point operands: 80 B/term
+affine X‖Y limbs (round 3) on top of 33 B/term digits.  But the X
+coordinate is pure RECOMPUTATION: the host has already made every
+accept/reject decision (decompression success, `s < ℓ`, and the final
+cofactored identity check all stay host-side — BASELINE.json north
+star), so the device can receive just the 32-byte y encoding plus a
+2-bit host-computed hint and rebuild x with exact balanced-limb
+arithmetic:
+
+    u = y² − 1,  v = d·y² + 1,
+    r₀ = u·v³ · (u·v⁷)^((p−5)/8)        (the RFC 8032 candidate root)
+    x  = r₀ · i^flip · (−1)^neg          (hint bits, see below)
+
+The hint byte per term carries `flip` (candidate failed the direct
+check, multiply by sqrt(−1) — reference scalar path
+native/fe25519.cpp zip215_decompress_batch) and `neg` (final x is the
+candidate's negation — covers both the even-root choice and the
+encoding's sign bit, including the ZIP215-legal x = −0).  Both bits are
+DATA computed by the host's own decompression, not decisions made on
+device: for a host-validated encoding the reconstruction is exact
+arithmetic with one preselected branch, and y ≥ p non-canonical
+encodings (ZIP215-accepted) work unchanged because balanced-limb math
+is mod-p congruent.  Parity with the host MSM over the full
+small-order/non-canonical conformance matrix is pinned by
+tests/test_device_parity.py and the driver's hardware-parity gate.
+
+Wire: (33, N) uint8 per batch — rows 0..31 the little-endian encoding
+bytes (bit 255 ignored; the sign is folded into `neg`), row 32 the hint
+byte (bit0 = flip, bit1 = neg).  33 B/term vs 80 B/term affine: 2.4×
+off the dominant transfer term (113 → 66 B/term with digits, 1.7×
+per call).
+
+Cost model: the inverse-sqrt chain is ~265 balanced-limb muls per
+point, executed in lane-blocked `lax.map` steps so the schoolbook
+intermediates stay tile-sized; on-chip arithmetic is ~3 orders of
+magnitude cheaper than this link's transfer floor (BASELINE.md
+"Device-lane economics"), so trading compute for bytes is the right
+direction on every remote-attached topology.
+"""
+
+from .field import D, P, SQRT_M1
+from . import limbs as limbs_mod
+from .limbs import LIMB_BITS, NLIMBS
+
+_D_LIMBS = [int(v) for v in limbs_mod.int_to_limbs(D % P)]
+_SQRTM1_LIMBS = [int(v) for v in limbs_mod.int_to_limbs(SQRT_M1 % P)]
+
+# Lanes per lax.map step of the decompression chain: bounds the
+# schoolbook mul intermediates ((20, 41, CHUNK_LANES) int32 ≈ 26 MB) so
+# XLA tiles them through VMEM instead of materializing a whole-batch
+# intermediate in HBM per chain step.
+CHUNK_LANES = 8192
+
+
+def _const_fe(vals, shape, jnp):
+    return jnp.stack([jnp.full(shape, v, jnp.int32) for v in vals])
+
+
+def unpack_y_limbs(enc_bytes, jnp):
+    """(32, ...) uint8 little-endian encoding bytes → (NLIMBS, ...)
+    int32 balanced-limb y with bit 255 masked out.  Limb i covers bits
+    [13i, 13i+13); each limb touches ≤ 3 bytes, all at static offsets,
+    so this is 20 unrolled shift-or-mask steps."""
+    b = enc_bytes.astype(jnp.int32)
+    top_masked = b[31] & 0x7F  # bit 255 is the sign slot, not y
+    out = []
+    for i in range(NLIMBS):
+        bit0 = LIMB_BITS * i
+        k, r = bit0 >> 3, bit0 & 7
+        limb = jnp.zeros_like(b[0])
+        for j, kk in enumerate((k, k + 1, k + 2)):
+            if kk > 31 or 8 * j - r >= LIMB_BITS:
+                continue
+            byte = top_masked if kk == 31 else b[kk]
+            sh = 8 * j - r
+            limb = limb | (byte << sh if sh >= 0 else byte >> -sh)
+        out.append(limb & ((1 << LIMB_BITS) - 1))
+    return jnp.stack(out)
+
+
+def pow22523(z, jnp):
+    """z^((p-5)/8) with (p-5)/8 = 2^252 − 3 over balanced limbs — the
+    standard 2^k−1 ladder (reference scalar chain fe_pow22523,
+    native/fe25519.cpp), with the long squaring runs as fori_loops so
+    the traced graph stays small."""
+    import jax
+
+    from . import jnp_field as F
+
+    def sqn(x, n):
+        if n <= 3:
+            for _ in range(n):
+                x = F.mul(x, x)
+            return x
+        return jax.lax.fori_loop(0, n, lambda i, a: F.mul(a, a), x)
+
+    t0 = F.mul(z, z)                      # z^2
+    t1 = sqn(t0, 2)                       # z^8
+    t1 = F.mul(t1, z)                     # z^9
+    t0 = F.mul(t0, t1)                    # z^11
+    t0 = F.mul(t0, t0)                    # z^22
+    t0 = F.mul(t1, t0)                    # z^(2^5-1)
+    t1 = sqn(t0, 5)
+    t0 = F.mul(t1, t0)                    # z^(2^10-1)
+    t1 = sqn(t0, 10)
+    t1 = F.mul(t1, t0)                    # z^(2^20-1)
+    t2 = sqn(t1, 20)
+    t1 = F.mul(t2, t1)                    # z^(2^40-1)
+    t1 = sqn(t1, 10)
+    t0 = F.mul(t1, t0)                    # z^(2^50-1)
+    t1 = sqn(t0, 50)
+    t1 = F.mul(t1, t0)                    # z^(2^100-1)
+    t2 = sqn(t1, 100)
+    t1 = F.mul(t2, t1)                    # z^(2^200-1)
+    t1 = sqn(t1, 50)
+    t0 = F.mul(t1, t0)                    # z^(2^250-1)
+    t0 = sqn(t0, 2)                       # z^(2^252-4)
+    return F.mul(t0, z)                   # z^(2^252-3)
+
+
+def decompress_block(enc_bytes, hints, jnp):
+    """One lane block: (32, L) uint8 encoding bytes + (L,) uint8 hints →
+    (4, NLIMBS, L) int32 extended coordinates (Z = 1, T = x·y)."""
+    from . import jnp_field as F
+
+    y = unpack_y_limbs(enc_bytes, jnp)
+    shape = y.shape[1:]
+    one = jnp.concatenate(
+        [jnp.ones((1,) + shape, jnp.int32),
+         jnp.zeros((NLIMBS - 1,) + shape, jnp.int32)], axis=0)
+    d = _const_fe(_D_LIMBS, shape, jnp)
+    sqrtm1 = _const_fe(_SQRTM1_LIMBS, shape, jnp)
+    yy = F.mul(y, y)
+    u = F.sub(yy, one)
+    v = F.add(F.mul(yy, d), one)
+    v3 = F.mul(F.mul(v, v), v)
+    v7 = F.mul(F.mul(v3, v3), v)
+    t1 = pow22523(F.mul(u, v7), jnp)
+    r = F.mul(F.mul(u, v3), t1)           # candidate root
+    h = hints.astype(jnp.int32)
+    r = F.select((h & 1) == 1, F.mul(r, sqrtm1), r)
+    x = F.select((h & 2) == 2, F.sub(jnp.zeros_like(r), r), r)
+    t = F.mul(x, y)
+    z = jnp.broadcast_to(one, x.shape)
+    return jnp.stack([x, y, z, t])
+
+
+def expand_compressed_points(wire):
+    """On-device expansion of the compressed wire: (B, 33, N) uint8 →
+    (B, 4, NLIMBS, N) int16 extended coordinates, in CHUNK_LANES-lane
+    `lax.map` steps.  Runs INSIDE the dispatch jit (ops/msm.py), like
+    the affine T-reconstruction it generalizes."""
+    import jax
+    import jax.numpy as jnp
+
+    B, rows, N = wire.shape
+    assert rows == 33
+    flat = jnp.moveaxis(wire, 1, 0).reshape(33, B * N)
+    total = B * N
+    ch = min(CHUNK_LANES, total)
+    if total % ch:
+        pad = ch - total % ch
+        # identity padding: y = 1 encoding, hint 0
+        ident = jnp.zeros((33, pad), jnp.uint8).at[0].set(1)
+        flat = jnp.concatenate([flat, ident], axis=1)
+        total += pad
+    nblk = total // ch
+    blocks = flat.reshape(33, nblk, ch)
+
+    def step(blk):
+        return decompress_block(blk[:32], blk[32], jnp)
+
+    out = jax.lax.map(step, jnp.moveaxis(blocks, 1, 0))
+    # (nblk, 4, NLIMBS, ch) → (4, NLIMBS, nblk·ch) → crop → (B,4,L,N)
+    out = jnp.moveaxis(out, 0, 2).reshape(4, NLIMBS, total)[..., :B * N]
+    out = out.reshape(4, NLIMBS, B, N)
+    return jnp.moveaxis(out, 2, 0).astype(jnp.int16)

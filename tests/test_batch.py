@@ -93,6 +93,72 @@ def test_empty_batch_verifies():
     batch.Verifier().verify(rng=rng)
 
 
+def _mixed_verifier(n=40, bad=False):
+    """Interleaved keys (gids cycle) so queue order ≠ group order."""
+    keys = [SigningKey.new(rng) for _ in range(7)]
+    v = batch.Verifier()
+    for i in range(n):
+        sk = keys[i % 7]
+        msg = b"qo-%d" % i
+        sig = sk.sign(msg if not (bad and i == 11) else b"tampered")
+        v.queue((sk.verification_key_bytes(), sig, msg))
+    return v
+
+
+def test_queue_order_staging_matches_grouped():
+    """The round-4 queue-order fast path and the grouped fallback:
+    with contiguous per-key runs (arrival order == group order) the two
+    stage the IDENTICAL batch — same coefficients, blinder pairing, and
+    MSM result; with interleaved keys the blinder→signature pairing
+    differs (both are valid RLC instances of the same equation set), so
+    equality holds on the point-row multiset and the verdict."""
+    # contiguous keys: byte-identical staging
+    v = batch.Verifier()
+    for j in range(5):
+        sk = SigningKey.new(rng)
+        for i in range(6):
+            msg = b"qo-run-%d-%d" % (j, i)
+            v.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    sq = v._stage_queue_order(random.Random(77))
+    sg = v._stage_grouped(random.Random(77))
+    assert sq.coeffs == sg.coeffs
+    assert sq.z_blob == sg.z_blob
+    assert bytes(sq.raw_points.tobytes()) == bytes(sg.raw_points.tobytes())
+    assert sq.host_msm() == sg.host_msm()
+    # interleaved keys: same equation set, same verdict, same rows
+    v = _mixed_verifier()
+    sq = v._stage_queue_order(random.Random(78))
+    sg = v._stage_grouped(random.Random(78))
+    assert sorted(map(bytes, sq.raw_points)) == \
+        sorted(map(bytes, sg.raw_points))
+    assert sq.host_msm().mul_by_cofactor().is_identity()
+    assert sg.host_msm().mul_by_cofactor().is_identity()
+
+
+def test_fused_host_path_agrees_with_staged_path(monkeypatch):
+    """verify(backend='host') uses the fused one-native-call path when
+    the queue-order buffers are live; forcing the staged path (buffers
+    invalidated) must give the same verdicts, valid and tampered."""
+    from ed25519_consensus_tpu import native
+
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    for bad in (False, True):
+        v = _mixed_verifier(bad=bad)
+        v2 = batch.Verifier()  # dict-poked clone: grouped/staged path
+        v2.signatures = {k: list(s) for k, s in v.signatures.items()}
+        v2.batch_size = v.batch_size
+
+        def verdict(bv):
+            try:
+                bv.verify(rng=random.Random(5), backend="host")
+                return True
+            except InvalidSignature:
+                return False
+
+        assert verdict(v) == verdict(v2) == (not bad)
+
+
 def test_batch_verify_across_msm_chunk_boundary():
     """The native MSM processes terms in cache-resident chunks of 10240;
     a batch whose term count crosses that boundary must still verify (and

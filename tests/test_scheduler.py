@@ -103,14 +103,23 @@ def test_error_chunk_benches_device_for_the_call(monkeypatch):
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
     # Slow the host so a (bogus) fast-error EMA would win the competitive
-    # check if it were (incorrectly) recorded.
+    # check if it were (incorrectly) recorded — both host paths (fused
+    # native call and staged fallback).
+    from ed25519_consensus_tpu import native
+
     real_host_msm = batch.StagedBatch.host_msm
+    real_fused = native.verify_host_batch
 
     def slow_host_msm(self):
         time.sleep(0.05)
         return real_host_msm(self)
 
+    def slow_fused(*a, **kw):
+        time.sleep(0.05)
+        return real_fused(*a, **kw)
+
     monkeypatch.setattr(batch.StagedBatch, "host_msm", slow_host_msm)
+    monkeypatch.setattr(native, "verify_host_batch", slow_fused)
     vs = make_verifiers(10, bad={3})
     verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     assert verdicts == expected(10, bad={3})
@@ -332,14 +341,25 @@ def test_competitive_device_wins_more_than_probe(monkeypatch):
 
     warm_kernel_cache()
     # Make the host lane artificially slow so the (CPU-backed) device
-    # kernel measures as competitive and keeps receiving chunks.
+    # kernel measures as competitive and keeps receiving chunks.  Both
+    # host implementations are slowed: the fused one-native-call path
+    # (what the host lane actually uses with live queue-order buffers)
+    # and the staged host_msm fallback.
+    from ed25519_consensus_tpu import native
+
     real_host_msm = batch.StagedBatch.host_msm
+    real_fused = native.verify_host_batch
 
     def slow_host_msm(self):
         time.sleep(0.25)
         return real_host_msm(self)
 
+    def slow_fused(*a, **kw):
+        time.sleep(0.25)
+        return real_fused(*a, **kw)
+
     monkeypatch.setattr(batch.StagedBatch, "host_msm", slow_host_msm)
+    monkeypatch.setattr(native, "verify_host_batch", slow_fused)
     vs = make_verifiers(12, bad={5})
     verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
     assert verdicts == expected(12, bad={5})

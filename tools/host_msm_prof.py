@@ -1,0 +1,72 @@
+"""Cycle-true host-path profile of a BASELINE config (default zcash10k).
+
+Phases timed per run: staging sub-steps (wall, perf_counter) and the
+native MSM's rdtsc phase counters (cycles — machine-speed-invariant on
+this ±25% node, the honest cross-session comparison).  Pure host: jax
+never loads.  Usage:
+
+    python tools/host_msm_prof.py [--config zcash10k] [--runs 5]
+    ED25519_TPU_MSM_FB=256 python tools/host_msm_prof.py   # block tuning
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("ED25519_TPU_DISABLE_DEVICE", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="zcash10k")
+    ap.add_argument("--runs", type=int, default=5)
+    args = ap.parse_args()
+
+    import bench
+    from ed25519_consensus_tpu import batch, native
+    from ed25519_consensus_tpu.utils.metrics import BatchMetrics
+
+    rng = random.Random(0xBE7C)
+    t0 = time.perf_counter()
+    bv = bench.build_batch(args.config, rng)
+    n = bv.batch_size
+    print(f"# built {args.config}: {n} sigs, {len(bv.signatures)} keys "
+          f"in {time.perf_counter()-t0:.1f}s "
+          f"(FB={os.environ.get('ED25519_TPU_MSM_FB', 'default')})",
+          flush=True)
+
+    bench.rebuild_fresh(bv).verify(rng=rng, backend="host")  # warm
+    best = None
+    for r in range(args.runs):
+        native.msm_profile_reset()
+        m = BatchMetrics()
+        t0 = time.perf_counter()
+        bench.rebuild_fresh(bv).verify(rng=rng, backend="host", metrics=m)
+        dt = time.perf_counter() - t0
+        prof = native.msm_profile()
+        row = (dt,
+               m.stage_seconds.get("stage_host",
+                                   m.stage_seconds.get("host_fused", 0)),
+               m.stage_seconds.get("msm", 0), prof)
+        if best is None or dt < best[0]:
+            best = row
+        print(f"# run{r}: {dt*1e3:.1f} ms -> {n/dt:.0f} sigs/s "
+              f"(stage {row[1]*1e3:.1f} msm {row[2]*1e3:.1f}) "
+              f"cycles tbl {prof['tbl_cycles']/1e6:.1f}M "
+              f"acc {prof['acc_cycles']/1e6:.1f}M "
+              f"horner {prof['horner_cycles']/1e6:.1f}M "
+              f"({prof['terms']} terms, {prof['calls']} calls)",
+              flush=True)
+    dt, st, msm_s, prof = best
+    print(f"BEST {args.config}: {dt*1e3:.1f} ms = {n/dt:.0f} sigs/s | "
+          f"stage {st*1e3:.1f} ms, msm {msm_s*1e3:.1f} ms | "
+          f"tbl {prof['tbl_cycles']/1e6:.1f}M acc "
+          f"{prof['acc_cycles']/1e6:.1f}M horner "
+          f"{prof['horner_cycles']/1e6:.1f}M cycles", flush=True)
+
+
+if __name__ == "__main__":
+    main()
