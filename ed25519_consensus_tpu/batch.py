@@ -101,20 +101,63 @@ _shift128_cache = {}
 _SHIFT_CACHE_MAX = 1 << 16
 
 
-def _shift128_for_key(vk_bytes: bytes, A_row) -> "object":
-    """Cached AFFINE [2^128]A; `A_row` is the key's raw 128-byte
-    coordinate row (only touched on a cache miss).  Normalizing at cache
-    time (one field inversion, amortized across the key's whole stream)
-    is what lets device staging ship X‖Y-only affine operands."""
+def _shift128_for_key(vk_bytes: bytes, A_row) -> "tuple":
+    """Cached `(point, enc, hint)` for the AFFINE [2^128]A; `A_row` is
+    the key's raw 128-byte coordinate row (only touched on a cache
+    miss).  Normalizing at cache time (one field inversion, amortized
+    across the key's whole stream) is what lets device staging ship
+    X‖Y-only affine operands; the compressed encoding + device hint
+    (computed once here, ~a Python pow) is what lets it ship the
+    33-byte compressed wire instead."""
     sp = _shift128_cache.get(vk_bytes)
     if sp is None:
         from . import native
 
-        sp = edwards.shift128(native.point_from_raw(A_row)).to_affine()
+        pt = edwards.shift128(native.point_from_raw(A_row)).to_affine()
+        enc, hint = edwards.compress_with_hint(pt)
+        sp = (pt, enc, hint)
         if len(_shift128_cache) >= _SHIFT_CACHE_MAX:
             _shift128_cache.pop(next(iter(_shift128_cache)))
         _shift128_cache[vk_bytes] = sp
     return sp
+
+
+_B_SHIFT_TRIPLE = None
+
+
+def _basepoint_shift_triple() -> "tuple":
+    """(point, enc, hint) for the cached [2^128]B."""
+    global _B_SHIFT_TRIPLE
+    if _B_SHIFT_TRIPLE is None:
+        pt = edwards.basepoint_shift128().to_affine()
+        enc, hint = edwards.compress_with_hint(pt)
+        _B_SHIFT_TRIPLE = (pt, enc, hint)
+    return _B_SHIFT_TRIPLE
+
+
+_B_WIRE = None
+
+
+def _basepoint_wire() -> "tuple":
+    """(enc, hint) for the basepoint itself (coefficient term 0)."""
+    global _B_WIRE
+    if _B_WIRE is None:
+        _B_WIRE = edwards.compress_with_hint(
+            edwards.BASEPOINT.to_affine())
+    return _B_WIRE
+
+
+def _device_wire_mode() -> str:
+    """Device point wire selection (ED25519_TPU_WIRE overrides):
+    `compressed` (default) ships 33 B/term — the 32-byte y encoding plus
+    the flip/neg hint — and recomputes x on-device
+    (ops/jnp_decompress.py); `affine` is the round-3 80 B/term X‖Y limb
+    format, kept for A/B and as the fallback when staging captured no
+    encodings."""
+    import os
+
+    v = os.environ.get("ED25519_TPU_WIRE", "compressed").lower()
+    return v if v in ("compressed", "affine") else "compressed"
 
 
 # Decompressed RAW key rows (canonical X‖Y‖Z‖T, 128 bytes) keyed by the
@@ -178,21 +221,29 @@ class StagedBatch:
 
     * coeffs: [B_coeff] + per-key A_coeffs, ints mod ℓ (may exceed 2^128 —
       the device path splits them against `coeff_shifts`).
-    * coeff_shifts: matching [2^128]·point host Points (basepoint constant
-      + per-key cache).
+    * coeff_shifts: matching (point, enc, hint) triples for the
+      [2^128]·point split terms (basepoint constant + per-key cache).
     * z_blob: the n per-signature 128-bit blinders as 16-byte
       little-endian rows (bytes, n×16).
     * raw_points: ((1+m+n), 128) uint8 — canonical X‖Y‖Z‖T rows for
       [B, A_0..A_{m-1}, R_0..R_{n-1}]; columns/terms order is
-      [coeff terms..., split-high terms..., R terms...]."""
+      [coeff terms..., split-high terms..., R terms...].
+    * enc32 / hints: the (m+n, 32) uint8 original compressed encodings
+      for [A..., R...] and their (m+n,) device flip/neg hint bytes —
+      the 33 B/term compressed device wire (None on paths that did not
+      capture them; device staging then falls back to affine)."""
 
-    __slots__ = ("coeffs", "coeff_shifts", "z_blob", "raw_points")
+    __slots__ = ("coeffs", "coeff_shifts", "z_blob", "raw_points",
+                 "enc32", "hints")
 
-    def __init__(self, coeffs, coeff_shifts, z_blob, raw_points):
+    def __init__(self, coeffs, coeff_shifts, z_blob, raw_points,
+                 enc32=None, hints=None):
         self.coeffs = coeffs
         self.coeff_shifts = coeff_shifts
         self.z_blob = z_blob
         self.raw_points = raw_points
+        self.enc32 = enc32
+        self.hints = hints
 
     @property
     def n_sigs(self) -> int:
@@ -224,17 +275,26 @@ class StagedBatch:
         ) + zs.tobytes()
         return native.vartime_msm_scblob(sblob, self.raw_points)
 
-    def device_operands(self, pad_fn):
-        """Build the padded device operands — signed digit planes
-        (NWINDOWS, N) int8 and AFFINE point limbs (2, NLIMBS, N) int16
-        (X‖Y only; T = X·Y and Z = 1 are reconstructed on-device, halving
-        the point H2D bytes — every staged point is affine: decompression
-        emits Z = 1 rows and the shift-point cache normalizes):
-        coefficients split into 128-bit chunks against their shift
-        points, blinder digits and point limbs packed vectorized from
-        the raw buffers."""
+    def device_operands(self, pad_fn, wire: "str | None" = None):
+        """Build the padded device operands: signed digit planes
+        (NWINDOWS, N) int8 plus the point wire —
+
+        * `compressed` (default when staging captured encodings): a
+          (33, N) uint8 array of 32-byte y encodings + flip/neg hint
+          bytes; x is recomputed on-device (ops/jnp_decompress.py) —
+          33 B/term.
+        * `affine`: (2, NLIMBS, N) int16 X‖Y limbs; T = X·Y and Z = 1
+          reconstructed on-device — 80 B/term.
+
+        Coefficients split into 128-bit chunks against their cached
+        shift points; blinder digits packed vectorized from the raw
+        buffers.  Term order: [coeffs..., split-highs..., R's...]."""
         from .ops import limbs
 
+        if wire is None:
+            wire = _device_wire_mode()
+        if self.enc32 is None or self.hints is None:
+            wire = "affine"  # staging path did not capture encodings
         mask = (1 << 128) - 1
         lo = [c & mask for c in self.coeffs]
         hi_s, hi_p = [], []
@@ -256,13 +316,28 @@ class StagedBatch:
                 self.n_sigs, 16
             )
             digits[:, n_head:n] = limbs.pack_u128_windows(zb)
+        if wire == "compressed":
+            m = n_coeff - 1  # distinct keys among the coefficient terms
+            w = limbs.identity_wire_batch(N)
+            b_enc, b_hint = _basepoint_wire()
+            w[:32, 0] = np.frombuffer(b_enc, dtype=np.uint8)
+            w[32, 0] = b_hint
+            if m:
+                w[:32, 1:n_coeff] = self.enc32[:m].T
+                w[32, 1:n_coeff] = self.hints[:m]
+            for j, sp in enumerate(hi_p):
+                w[:32, n_coeff + j] = np.frombuffer(sp[1], dtype=np.uint8)
+                w[32, n_coeff + j] = sp[2]
+            w[:32, n_head:n] = self.enc32[m:].T
+            w[32, n_head:n] = self.hints[m:]
+            return digits, w
         pts = limbs.identity_affine_batch(N)
         pts[..., :n_coeff] = limbs.pack_points_affine_from_raw(
             self.raw_points[:n_coeff]
         )
         if hi_p:
             pts[..., n_coeff:n_head] = limbs.pack_point_affine_batch(
-                hi_p
+                [sp[0] for sp in hi_p]
             ).astype(np.int16)
         pts[..., n_head:n] = limbs.pack_points_affine_from_raw(
             self.raw_points[n_coeff:]
@@ -407,9 +482,11 @@ class Verifier:
         keys = list(self._key_index)  # vk_bytes in group-id order
         m = len(keys)
         blob = b"".join([k.to_bytes() for k in keys] + [self._r_buf])
-        raw, ok = native.decompress_batch_buffer(blob, m + n)
+        raw, ok, hints = native.decompress_batch_buffer(
+            blob, m + n, return_hints=True)
         if not ok.all():
             raise InvalidSignature()
+        enc32 = np.frombuffer(blob, dtype=np.uint8).reshape(m + n, 32)
         if rng is None:
             z_blob = secrets.token_bytes(16 * n)
         else:
@@ -445,9 +522,11 @@ class Verifier:
         )  # rows: [B, A_0..A_{m-1}, then R's in arrival order]
         return StagedBatch(
             coeffs=[(-B_acc) % L] + [a % L for a in A_accs],
-            coeff_shifts=[edwards.basepoint_shift128()] + A_shifts,
+            coeff_shifts=[_basepoint_shift_triple()] + A_shifts,
             z_blob=z_blob,
             raw_points=raw_points,
+            enc32=enc32,
+            hints=hints,
         )
 
     def _stage_grouped(self, rng) -> "StagedBatch":
@@ -470,9 +549,12 @@ class Verifier:
         parts = [vkb.to_bytes() for vkb, _ in groups]
         for _, sigs in groups:
             parts.extend(sig.R_bytes for _, sig in sigs)
-        raw, ok = native.decompress_batch_buffer(b"".join(parts), m + n)
+        blob = b"".join(parts)
+        raw, ok, hints = native.decompress_batch_buffer(
+            blob, m + n, return_hints=True)
         if not ok.all():
             raise InvalidSignature()
+        enc32 = np.frombuffer(blob, dtype=np.uint8).reshape(m + n, 32)
 
         # Per-signature blobs (queue order) + one bulk draw of blinders.
         s_blob = b"".join(
@@ -527,9 +609,11 @@ class Verifier:
         )  # rows: [B, A_0..A_{m-1}, R_0..R_{n-1}]
         return StagedBatch(
             coeffs=[(-B_acc) % L] + [a % L for a in A_accs],
-            coeff_shifts=[edwards.basepoint_shift128()] + A_shifts,
+            coeff_shifts=[_basepoint_shift_triple()] + A_shifts,
             z_blob=z_blob,
             raw_points=raw_points,
+            enc32=enc32,
+            hints=hints,
         )
 
     # -- verification ------------------------------------------------------
@@ -1177,8 +1261,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             digits = np.concatenate(
                 [digits, np.zeros((nb,) + digits.shape[1:], np.int8)]
             )
-            mk_ident = (limbs.identity_affine_batch if pts.shape[1] == 2
-                        else limbs.identity_point_batch)
+            mk_ident = {2: limbs.identity_affine_batch,
+                        33: limbs.identity_wire_batch}.get(
+                pts.shape[1], limbs.identity_point_batch)
             ident = mk_ident(pts.shape[-1])
             pts = np.concatenate(
                 [pts, np.stack([ident] * nb).astype(pts.dtype)]

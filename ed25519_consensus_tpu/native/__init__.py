@@ -83,6 +83,7 @@ def load():
             ctypes.c_uint64,
             ctypes.c_char_p,
             ctypes.c_char_p,
+            ctypes.c_char_p,  # hints (nullable)
         ]
         lib.zip215_decompress_batch.restype = None
         lib.edwards_vartime_msm.argtypes = [
@@ -216,7 +217,7 @@ def _decompress_batch_raw(lib, encodings):
     blob = b"".join(encodings)
     out = ctypes.create_string_buffer(128 * n)
     ok = ctypes.create_string_buffer(n)
-    lib.zip215_decompress_batch(blob, n, out, ok)
+    lib.zip215_decompress_batch(blob, n, out, ok, None)
     res = []
     buf = out.raw
     okb = ok.raw  # .raw copies the whole buffer on EVERY access
@@ -248,11 +249,14 @@ def decompress_batch(encodings):
     return [edwards.decompress(e) for e in encodings]
 
 
-def decompress_batch_buffer(blob: bytes, n: int):
+def decompress_batch_buffer(blob: bytes, n: int,
+                            return_hints: bool = False):
     """Batched ZIP215 decompression, buffer form: `blob` is n
     concatenated 32-byte encodings; returns (raw, ok) numpy arrays of
-    shapes (n, 128) uint8 / (n,) uint8.  `raw` rows are canonical X‖Y‖Z‖T
-    32-byte little-endian coords — exactly the limb-packing input format
+    shapes (n, 128) uint8 / (n,) uint8 — or (raw, ok, hints) with
+    `return_hints`, where hints[i] carries the device-wire flip/neg bits
+    (ops/jnp_decompress.py).  `raw` rows are canonical X‖Y‖Z‖T 32-byte
+    little-endian coords — exactly the limb-packing input format
     (ops/limbs.pack_points_from_raw) and the native-MSM point format, so
     the staging path never materializes per-point Python objects."""
     import numpy as np
@@ -261,31 +265,46 @@ def decompress_batch_buffer(blob: bytes, n: int):
     if lib is not None:
         out = ctypes.create_string_buffer(128 * n)
         ok = ctypes.create_string_buffer(n)
-        lib.zip215_decompress_batch(blob, n, out, ok)
+        hints = ctypes.create_string_buffer(n) if return_hints else None
+        lib.zip215_decompress_batch(blob, n, out, ok, hints)
         # frombuffer on the ctypes buffer itself is a zero-copy view
         # (one .copy() to own it) — .raw would copy the whole buffer an
         # extra time per access
-        return (
+        res = (
             np.frombuffer(out, dtype=np.uint8,
                           count=128 * n).reshape(n, 128).copy(),
             np.frombuffer(ok, dtype=np.uint8, count=n).copy(),
         )
+        if return_hints:
+            res += (np.frombuffer(hints, dtype=np.uint8, count=n).copy(),)
+        return res
     # Exact-Python fallback (CI without a toolchain).
     from ..ops import edwards
     from ..ops.field import P
 
     raw = np.zeros((n, 128), dtype=np.uint8)
     ok = np.zeros((n,), dtype=np.uint8)
+    hints = np.zeros((n,), dtype=np.uint8)
     for i in range(n):
-        pt = edwards.decompress(blob[32 * i : 32 * (i + 1)])
-        if pt is None:
-            continue
+        enc = blob[32 * i : 32 * (i + 1)]
+        if return_hints:
+            # one exponentiation chain for point + hint together
+            res = edwards.decompress_with_hint(enc)
+            if res is None:
+                continue
+            pt, hints[i] = res
+        else:
+            pt = edwards.decompress(enc)
+            if pt is None:
+                continue
         ok[i] = 1
         row = b"".join(
             (c % P).to_bytes(32, "little")
             for c in (pt.X, pt.Y, pt.Z, pt.T)
         )
         raw[i] = np.frombuffer(row, dtype=np.uint8)
+    if return_hints:
+        return raw, ok, hints
     return raw, ok
 
 

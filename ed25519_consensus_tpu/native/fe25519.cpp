@@ -513,7 +513,8 @@ IFMA_TARGET static void dec8_prepare(const uint8_t *enc, dec8_state &st) {
 }
 
 IFMA_TARGET static void dec8_finish(const dec8_state &st, const fe8 &t1,
-                                    uint8_t *out, uint8_t *ok) {
+                                    uint8_t *out, uint8_t *ok,
+                                    uint8_t *hints) {
     const fe8 &y = st.y;
     const fe8 &u = st.u;
     const fe8 &v = st.v;
@@ -545,6 +546,16 @@ IFMA_TARGET static void dec8_finish(const dec8_state &st, const fe8 &t1,
         const __m512i one64 = _mm512_set1_epi64(1);
         odd = _mm512_cmpeq_epu64_mask(
             _mm512_and_si512(r.v[0], one64), one64);
+    }
+    if (hints) {
+        // Device-wire hint bits (ops/jnp_decompress.py): bit0 = the
+        // candidate root needed the sqrt(-1) fixup, bit1 = the final x
+        // is the (post-fixup) candidate's negation — the two cnegs
+        // below compose to odd XOR sign.
+        __mmask8 negb = odd ^ sign_m;
+        for (int l = 0; l < 8; l++)
+            hints[l] = (uint8_t)((((flip >> l) & 1)) |
+                                 (((negb >> l) & 1) << 1));
     }
     fe8_cneg(r, odd);               // even root
     fe8_cneg(r, sign_m);            // sign bit (x = 0 allowed per ZIP215)
@@ -587,12 +598,12 @@ IFMA_TARGET static void dec8_finish(const dec8_state &st, const fe8 &t1,
 }
 
 IFMA_TARGET static void decompress8(const uint8_t *enc, uint8_t *out,
-                                    uint8_t *ok) {
+                                    uint8_t *ok, uint8_t *hints) {
     dec8_state st;
     dec8_prepare(enc, st);
     fe8 t1;
     fe8_pow22523(t1, st.t0);
-    dec8_finish(st, t1, out, ok);
+    dec8_finish(st, t1, out, ok, hints);
 }
 
 // Two interleaved inverse-sqrt chains: the 252 squarings are a pure
@@ -637,14 +648,15 @@ IFMA_TARGET static void fe8_pow22523_x2(fe8 &o1, fe8 &o2, const fe8 &z1,
 }
 
 IFMA_TARGET static void decompress16(const uint8_t *enc, uint8_t *out,
-                                     uint8_t *ok) {
+                                     uint8_t *ok, uint8_t *hints) {
     dec8_state sa, sb;
     dec8_prepare(enc, sa);
     dec8_prepare(enc + 32 * 8, sb);
     fe8 t1a, t1b;
     fe8_pow22523_x2(t1a, t1b, sa.t0, sb.t0);
-    dec8_finish(sa, t1a, out, ok);
-    dec8_finish(sb, t1b, out + 128 * 8, ok + 8);
+    dec8_finish(sa, t1a, out, ok, hints);
+    dec8_finish(sb, t1b, out + 128 * 8, ok + 8,
+                hints ? hints + 8 : nullptr);
 }
 
 }  // namespace ifma
@@ -1410,8 +1422,13 @@ int stage_scalars(const uint8_t *s_bytes, const uint8_t *k_bytes,
 //   out:       n * 128 bytes — X ‖ Y ‖ Z ‖ T, each a canonical 32-byte
 //              little-endian field encoding (Z = 1)
 //   ok:        n bytes — 1 if the encoding decompressed, else 0
+//   hints:     n bytes or NULL — per-point device-wire hint (round 4,
+//              ops/jnp_decompress.py): bit0 = the candidate root
+//              u·v³·(u·v⁷)^((p−5)/8) needed the sqrt(−1) fixup, bit1 =
+//              the final x is the (post-fixup) candidate's negation.
+//              Only meaningful where ok = 1.
 void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
-                             uint8_t *out, uint8_t *ok) {
+                             uint8_t *out, uint8_t *ok, uint8_t *hints) {
     uint64_t i0 = 0;
 #if defined(__x86_64__)
     if (ifma_available()) {
@@ -1419,10 +1436,10 @@ void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
         // scalar tail below.
         for (; i0 + 16 <= n; i0 += 16)
             ifma::decompress16(encodings + 32 * i0, out + 128 * i0,
-                               ok + i0);
+                               ok + i0, hints ? hints + i0 : nullptr);
         for (; i0 + 8 <= n; i0 += 8)
             ifma::decompress8(encodings + 32 * i0, out + 128 * i0,
-                              ok + i0);
+                              ok + i0, hints ? hints + i0 : nullptr);
     }
 #endif
     for (uint64_t i = i0; i < n; i++) {
@@ -1452,6 +1469,7 @@ void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
         fe_sq(chk, r);
         fe_mul(chk, chk, v);       // chk = v r^2, should be ±u
         bool good;
+        int flip = 0;
         if (fe_eq(chk, u)) {
             good = true;
         } else {
@@ -1459,6 +1477,7 @@ void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
             fe_neg(mu, u);
             if (fe_eq(chk, mu)) {
                 fe_mul(r, r, FE_SQRTM1);
+                flip = 1;
                 good = true;
             } else {
                 good = fe_iszero(u);  // u == 0 ⇒ x = 0 (r is 0 already)
@@ -1467,9 +1486,12 @@ void zip215_decompress_batch(const uint8_t *encodings, uint64_t n,
         if (!good) {
             ok[i] = 0;
             memset(o, 0, 128);
+            if (hints) hints[i] = 0;
             continue;
         }
-        if (fe_isnegative(r)) fe_neg(r, r);  // choose the even root
+        int odd = fe_isnegative(r) ? 1 : 0;
+        if (hints) hints[i] = (uint8_t)(flip | ((odd ^ sign) << 1));
+        if (odd) fe_neg(r, r);               // choose the even root
         if (sign) fe_neg(r, r);              // apply the sign bit (x=0 ok)
 
         fe t;
@@ -1723,7 +1745,7 @@ int verify_host_gid(const uint8_t *key_rows, const uint8_t *rs,
 
     memcpy(points, b_row, 128);
     memcpy(points + 128, key_rows, 128 * m);
-    zip215_decompress_batch(rs, n, points + 128 * (1 + m), ok);
+    zip215_decompress_batch(rs, n, points + 128 * (1 + m), ok, nullptr);
     for (uint64_t i = 0; i < n; i++)
         if (!ok[i]) return -1;
 

@@ -183,6 +183,59 @@ def decompress(b: bytes):
     return Point(x, y, 1, x * y % P)
 
 
+def decompress_with_hint(b: bytes):
+    """ZIP215 decompression + device-wire hint in ONE exponentiation
+    chain: returns (Point, hint) or None — the exact-Python analog of
+    the native hints-emitting decompression (used by the no-toolchain
+    staging fallback, where running `decompress` and then
+    `decompression_hint` would pay the dominant pow twice)."""
+    if len(b) != 32:
+        return None
+    sign = b[31] >> 7
+    y = field.from_bytes(b)
+    u = (y * y - 1) % P
+    v = (D * y % P * y + 1) % P
+    res = field.sqrt_ratio_hint(u, v)
+    if res is None:
+        return None
+    x, r, flip = res
+    if sign:
+        x = (-x) % P
+    hint = (1 if flip else 0) | (0 if x == r else 2)
+    return Point(x, y, 1, x * y % P), hint
+
+
+def decompression_hint(y: int, x: int) -> int:
+    """Device-wire hint bits for on-device x-recomputation
+    (ops/jnp_decompress.py): given a point's y and its ZIP215-decompressed
+    x (both mod p, any representatives), compute bit0 = the RFC 8032
+    candidate root r₀ = u·v³·(u·v⁷)^((p−5)/8) needs the sqrt(−1) fixup,
+    and bit1 = the final x is the (post-fixup) candidate's negation.
+    Pure data derived from the host's own decompression — the device
+    applies them as arithmetic selects, never as accept/reject logic.
+    Mirrors the native hint emission (fe25519.cpp dec8_finish and the
+    scalar tail)."""
+    y %= P
+    x %= P
+    u = (y * y - 1) % P
+    v = (D * y % P * y + 1) % P
+    r0 = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P,
+                                    (P - 5) // 8, P) % P
+    chk = v * r0 % P * r0 % P
+    flip = chk != u and chk == (P - u) % P
+    r = r0 * SQRT_M1 % P if flip else r0
+    return (1 if flip else 0) | (0 if x == r else 2)
+
+
+def compress_with_hint(pt: "Point"):
+    """(32-byte encoding, hint byte) for an AFFINE host point — the
+    compressed-wire form of cached coefficient points (basepoint and
+    [2^128]·key shift points, batch.py)."""
+    if pt.Z % P != 1:
+        raise ValueError("compress_with_hint requires Z = 1 points")
+    return pt.compress(), decompression_hint(pt.Y, pt.X)
+
+
 # -- basepoint and fixed-base table ---------------------------------------
 
 # B = (x, 4/5) with the even root for x (RFC 8032 §5.1).

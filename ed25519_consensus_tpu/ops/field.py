@@ -64,22 +64,34 @@ def sqrt_ratio(u: int, v: int):
     The candidate root is r = u * v^3 * (u * v^7)^((p-5)/8); then
     v*r^2 ∈ {u, -u, u*i, -u*i} and only the first two cases are squares.
     """
+    res = sqrt_ratio_hint(u, v)
+    return None if res is None else res[0]
+
+
+def sqrt_ratio_hint(u: int, v: int):
+    """Like `sqrt_ratio` but also expose the device-wire hint inputs
+    from the SAME exponentiation chain: returns (x, r, flip) where x is
+    the chosen even root, r the post-fixup candidate
+    u·v³·(u·v⁷)^((p−5)/8)·i^flip, and flip whether the sqrt(−1) fixup
+    fired; or None for a non-residue.  One pow chain serves both the
+    decompression and the hint (ops/jnp_decompress wire)."""
     u %= P
     v %= P
     v3 = (v * v % P) * v % P
     v7 = (v3 * v3 % P) * v % P
     r = (u * v3 % P) * pow(u * v7 % P, (P - 5) // 8, P) % P
     check = v * r % P * r % P
+    flip = 0
     if check == u:
         pass
     elif check == P - u:
         r = r * SQRT_M1 % P
+        flip = 1
     elif u != 0:
         # check == ±u*i: not a square (u == 0 handled by check==u above).
         return None
-    if r & 1:  # choose the nonnegative (even-encoding) root
-        r = P - r
-    return r
+    x = P - r if r & 1 else r  # the nonnegative (even-encoding) root
+    return x, r, flip
 
 
 def to_bytes(a: int) -> bytes:

@@ -206,3 +206,11 @@ def identity_affine_batch(n: int) -> np.ndarray:
     out = np.zeros((2, NLIMBS, n), dtype=np.int16)
     out[1, 0, :] = 1
     return out
+
+
+def identity_wire_batch(n: int) -> np.ndarray:
+    """(33, n) uint8 compressed-wire identity batch: the y = 1 encoding
+    (byte 0 = 1) with hint 0 — decompresses on-device to (0, 1)."""
+    out = np.zeros((33, n), dtype=np.uint8)
+    out[0, :] = 1
+    return out

@@ -354,32 +354,65 @@ def expand_affine_points_single(points):
     return expand_affine_points(points[None])[0]
 
 
+# Device point wire formats (auto-detected from the batched points
+# array's second axis):
+#   "extended"   (B, 4, NLIMBS, N) int16 — X‖Y‖Z‖T limbs (legacy)
+#   "affine"     (B, 2, NLIMBS, N) int16 — X‖Y limbs; T, Z on-device
+#   "compressed" (B, 33, N) uint8 — 32 encoding bytes + hint byte;
+#                full ZIP215 x-recomputation on-device
+#                (ops/jnp_decompress.py) — 33 B/term vs affine's 80.
+def wire_of(points) -> str:
+    c = points.shape[1]
+    if c == 33:
+        return "compressed"
+    if c == 2:
+        return "affine"
+    return "extended"
+
+
+def expand_points(points, wire: str):
+    """On-device expansion of any wire format to batched extended
+    coordinates (B, 4, NLIMBS, N); runs inside the dispatch jit."""
+    if wire == "affine":
+        return expand_affine_points(points)
+    if wire == "compressed":
+        from . import jnp_decompress
+
+        return jnp_decompress.expand_compressed_points(points)
+    return points
+
+
+def expand_points_single(points, wire: str):
+    """Unbatched wire expansion (the sharded per-device shard path)."""
+    return expand_points(points[None], wire)[0]
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_kernel_many(n_batches: int, n_lanes: int,
-                          nwin: int = NWINDOWS, affine: bool = False):
+                          nwin: int = NWINDOWS, wire: str = "extended"):
     """vmap of the XLA scan kernel over a leading batch axis: B independent
     verification batches in ONE device call (the per-call tunnel round-trip
-    dominates on remote-attached devices).  With `affine`, points arrive
-    as (B, 2, NLIMBS, N) and are expanded on-device."""
+    dominates on remote-attached devices).  Non-extended `wire` formats
+    are expanded on-device inside the same jit."""
     import jax
 
     kernel = _compiled_kernel.__wrapped__(n_lanes, nwin)
     vk = jax.vmap(kernel)
-    if not affine:
+    if wire == "extended":
         return jax.jit(vk)
 
-    def f(digits, pts2):
-        return vk(digits, expand_affine_points(pts2))
+    def f(digits, pts):
+        return vk(digits, expand_points(pts, wire))
 
     return jax.jit(f)
 
 
 def dispatch_window_sums_many(digits, points):
     """One device call for B stacked batches: digits (B, NWINDOWS, N),
-    points (B, 4, NLIMBS, N) legacy extended format OR (B, 2, NLIMBS, N)
-    affine X‖Y format (auto-detected; T/Z reconstructed on-device) →
-    (B, 4, NLIMBS, NWINDOWS) device array with its D2H copy in flight."""
-    affine = points.shape[1] == 2
+    points in any wire format (see wire_of; expansion happens on-device)
+    → (B, 4, NLIMBS, NWINDOWS) device array with its D2H copy in
+    flight."""
+    wire = wire_of(points)
     with DEVICE_CALL_LOCK:
         if _use_pallas():
             from . import pallas_msm
@@ -388,7 +421,7 @@ def dispatch_window_sums_many(digits, points):
         else:
             out = _compiled_kernel_many(digits.shape[0], digits.shape[2],
                                         digits.shape[1],
-                                        affine=affine)(digits, points)
+                                        wire=wire)(digits, points)
         try:
             out.copy_to_host_async()
         except AttributeError:

@@ -312,7 +312,7 @@ def _body_style() -> str:
 def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
                        interpret: bool = False, tile=(SUBLANES, LANES),
                        tbl_dtype="int16", win_chunk: int = 1,
-                       body: str | None = None, affine: bool = False):
+                       body: str | None = None, wire: str = "extended"):
     """ONE jitted function for the whole device step: Pallas partial-sum
     kernel + XLA fold of the per-block partials, so a multi-batch
     verification is a single tunnel call.
@@ -336,10 +336,10 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     fS = min(FOLD_SUBLANES, S)
 
     def pipeline(digits, points):
-        if affine:
-            from .msm import expand_affine_points
+        if wire != "extended":
+            from .msm import expand_points
 
-            points = expand_affine_points(points)
+            points = expand_points(points, wire)
         dig = digits.reshape(n_batches, nwin, n_blocks, S, Ln)
         pts = points.reshape(
             n_batches, 4, NLIMBS, n_blocks, S, Ln
@@ -415,11 +415,13 @@ def pallas_window_sums_many(digits, points, interpret: bool = False,
         win_chunk = _auto_win_chunk(nwin)
     if body is None:
         body = _body_style()  # resolved here so the env is re-read per call
+    from .msm import wire_of
+
     return _compiled_pipeline(B, N, nwin, interpret=interpret, tile=tile,
                               tbl_dtype=tbl_dtype,
                               win_chunk=win_chunk,
                               body=body,
-                              affine=points.shape[1] == 2)(digits, points)
+                              wire=wire_of(points))(digits, points)
 
 
 def pallas_window_sums(digits, points, interpret: bool = False,

@@ -28,13 +28,14 @@ from . import mesh as mesh_lib
 
 @functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
-                             nwin: int, affine: bool = False):
+                             nwin: int, wire: str = "extended"):
     """jit a shard_map'd MSM over a 1-D batch mesh.
 
-    Input shapes (global): digits (nwin, N), points (4, NLIMBS, N) —
-    or, with `affine`, (2, NLIMBS, N) X‖Y limbs expanded per-shard
-    on-device — with N = n_devices * lanes_per_device; output:
-    replicated (4, NLIMBS, nwin) window sums."""
+    Input shapes (global): digits (nwin, N), points in any wire format
+    (extended (4, NLIMBS, N), affine (2, NLIMBS, N), or compressed
+    (33, N) uint8 — expanded per-shard on-device, so the ICI/H2D bytes
+    shrink with the wire) with N = n_devices * lanes_per_device;
+    output: replicated (4, NLIMBS, nwin) window sums."""
     msm_lib.ensure_compile_cache()
     import jax
     from jax.sharding import PartitionSpec as P
@@ -54,9 +55,9 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
     )  # un-jitted builder result is already a jit fn; call inside shard_map
 
     def shard_fn(digits, points):
-        # Per-device shard: (nwin, N/D), (4|2, NLIMBS, N/D)
-        if affine:
-            points = msm_lib.expand_affine_points_single(points)
+        # Per-device shard: (nwin, N/D) + the wire's point shard
+        if wire != "extended":
+            points = msm_lib.expand_points_single(points, wire)
         part = local_kernel(digits, points)  # (4, NLIMBS, nwin)
         # ICI all-reduce in the Edwards group: gather the D partial window
         # sums and fold them with the complete addition law (vectorized
@@ -69,9 +70,11 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
         out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]), gathered)
         return out
 
+    pts_spec = P(None, axis) if wire == "compressed" \
+        else P(None, None, axis)  # compressed wire is rank 2: (33, N)
     kwargs = dict(
         mesh=mesh,
-        in_specs=(P(None, axis), P(None, None, axis)),
+        in_specs=(P(None, axis), pts_spec),
         out_specs=P(),  # replicated result
     )
     try:  # the replication-check kwarg was renamed across jax versions
@@ -84,7 +87,7 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
 @functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
                                   lanes_per_device: int, nwin: int,
-                                  affine: bool = False):
+                                  wire: str = "extended"):
     """Batched mesh kernel for the throughput scheduler: B stacked
     verification batches, each one's MSM terms sharded over the device
     mesh, partial Edwards sums all-gathered and folded per batch — one
@@ -112,9 +115,9 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
     )
 
     def shard_fn(digits, points):
-        # per-device: (B, nwin, N/D), (B, 2|4, NLIMBS, N/D)
-        if affine:
-            points = msm_lib.expand_affine_points(points)
+        # per-device: (B, nwin, N/D) + the wire's point shard
+        if wire != "extended":
+            points = msm_lib.expand_points(points, wire)
         part = jax.vmap(local_kernel)(digits, points)  # (B,4,NLIMBS,nwin)
         # point tensors lead with (4, NLIMBS) for the Edwards fold
         part = jnp.transpose(part, (1, 2, 0, 3))  # (4, NLIMBS, B, nwin)
@@ -126,9 +129,11 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
         out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]), gathered)
         return jnp.transpose(out, (2, 0, 1, 3))  # (B, 4, NLIMBS, nwin)
 
+    pts_spec = P(None, None, axis) if wire == "compressed" \
+        else P(None, None, None, axis)  # compressed wire: (B, 33, N)
     kwargs = dict(
         mesh=mesh,
-        in_specs=(P(None, None, axis), P(None, None, None, axis)),
+        in_specs=(P(None, None, axis), pts_spec),
         out_specs=P(),
     )
     try:
@@ -140,11 +145,11 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
 
 def sharded_window_sums_many(digits, pts, n_devices: int):
     """Batched mesh dispatch (the scheduler's device-lane call when a
-    mesh is configured): digits (B, nwin, N), points in the legacy or
-    affine wire format → (B, 4, NLIMBS, nwin) device array."""
+    mesh is configured): digits (B, nwin, N), points in any wire format
+    → (B, 4, NLIMBS, nwin) device array."""
     return _compiled_sharded_kernel_many(
         n_devices, digits.shape[0], digits.shape[2] // n_devices,
-        digits.shape[1], affine=pts.shape[1] == 2,
+        digits.shape[1], wire=msm_lib.wire_of(pts),
     )(digits, pts)
 
 
@@ -165,11 +170,11 @@ def _shard_pad(n: int, n_devices: int) -> int:
 
 def sharded_window_sums(digits, pts, n_devices: int):
     """Dispatch pre-packed operands over the mesh; returns the replicated
-    (4, NLIMBS, nwin) window sums as a device array.  Points in the
-    legacy (4, NLIMBS, N) or affine (2, NLIMBS, N) wire format."""
+    (4, NLIMBS, nwin) window sums as a device array.  Points in any
+    wire format (unbatched: (4|2, NLIMBS, N) limbs or (33, N) uint8)."""
     kernel, _ = _compiled_sharded_kernel(
         n_devices, digits.shape[1] // n_devices, digits.shape[0],
-        affine=pts.shape[0] == 2,
+        wire=msm_lib.wire_of(pts[None]),
     )
     return kernel(digits, pts)
 

@@ -99,6 +99,42 @@ def test_batch_verify_device_backend_rejects_bad():
         bv.verify_tpu(rng=rng)
 
 
+def test_compressed_wire_matches_affine_wire():
+    """Round-4 compressed (33 B/term y+hint) wire vs the affine X‖Y
+    wire: the SAME staged batch dispatched through both formats must
+    yield identical window sums — covering on-device x-recomputation
+    for torsion keys, non-canonical encodings (ZIP215 y ≥ p), split
+    coefficient terms (cached shift-point encodings), and identity
+    padding."""
+    from ed25519_consensus_tpu.ops import msm
+    from ed25519_consensus_tpu.utils import fixtures
+
+    bv = batch.Verifier()
+    encs = [p.compress() for p in edwards.eight_torsion()[:4]]
+    encs += fixtures.non_canonical_point_encodings()[:4]
+    for A in encs:
+        bv.queue((A, Signature(encs[-1], b"\x00" * 32), b"Zcash"))
+    for i in range(5):
+        sk = SigningKey.new(rng)
+        msg = b"wire ab %d" % i
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    staged = bv._stage(random.Random(42))
+    dig_c, wire_c = staged.device_operands(msm.preferred_pad,
+                                           wire="compressed")
+    dig_a, wire_a = staged.device_operands(msm.preferred_pad,
+                                           wire="affine")
+    assert wire_c.shape[0] == 33 and wire_c.dtype == np.uint8
+    assert wire_a.shape[0] == 2
+    assert np.array_equal(dig_c, dig_a)
+    out_c = np.asarray(msm.dispatch_window_sums(dig_c, wire_c))
+    out_a = np.asarray(msm.dispatch_window_sums(dig_a, wire_a))
+    got_c = msm.combine_window_sums(out_c)
+    got_a = msm.combine_window_sums(out_a)
+    assert got_c == got_a
+    # and both agree with the exact host MSM over the staged terms
+    assert got_c == staged.host_msm()
+
+
 def test_verify_many_pad_covers_split_terms():
     """verify_many must size the common lane pad from the count INCLUDING
     the 128-bit split-high terms (regression: 130 distinct-key sigs made
