@@ -370,6 +370,119 @@ def test_competitive_device_wins_more_than_probe(monkeypatch):
     )
 
 
+# -- mesh-lane failure injection (VERDICT r3 #6) --------------------------
+# The mesh>1 device lane (one batched shard_map launch per chunk) must
+# survive the same adversarial conditions as the single-device lane:
+# error chunks, deadline misses, and probe discards — with verdicts
+# always decided by the exact host math.  The happy-path mesh lane is
+# covered by tests/test_sharding.py and the driver's dryrun_multichip;
+# these tests inject failures at the sharded dispatch boundary.
+
+MESH = 2
+
+
+def warm_mesh_shapes(chunk=2, mesh=MESH):
+    """Mark the padded (chunk, lanes, mesh) shape completed so the
+    scheduler applies the normal deadline, not the first-compile grace
+    (mirrors production warm_device_shapes + the lane worker's
+    mark_shape_completed)."""
+    from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+    vs = make_verifiers(1)
+    staged = vs[0]._stage(rng)
+    pad = shard_pad(staged.n_device_terms, mesh)
+    msm.mark_shape_completed(chunk, pad, mesh)
+    return pad
+
+
+def test_mesh_error_chunk_falls_back_to_host(monkeypatch):
+    """A mesh dispatch that raises → every batch re-decided on the host;
+    the error benches the mesh lane for the rest of the call."""
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    warm_mesh_shapes()
+    calls = []
+
+    def boom(digits, pts, n_devices):
+        calls.append((digits.shape[0], n_devices))
+        raise RuntimeError("injected mesh error")
+
+    monkeypatch.setattr(sharded_msm, "sharded_window_sums_many", boom)
+    vs = make_verifiers(8, bad={2})
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never",
+                                 mesh=MESH)
+    assert verdicts == expected(8, bad={2})
+    stats = batch.last_run_stats
+    assert stats["device_batches"] == 0
+    assert stats["host_batches"] == 8
+    assert not stats["device_sick"]
+    assert calls == [(2, MESH)]  # exactly the probe reached the mesh
+
+
+def test_mesh_deadline_miss_abandons_mesh_lane(monkeypatch):
+    """A stalled mesh call past the (warmed-shape) deadline → device
+    sick, batches re-verified on host, the MESH-mode lane abandoned and
+    the cooldown armed — without touching the single-device lane
+    registry slot."""
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    warm_mesh_shapes()
+    release = threading.Event()
+
+    def stall(digits, pts, n_devices):
+        release.wait(timeout=30.0)
+        raise RuntimeError("stalled mesh call")
+
+    monkeypatch.setattr(sharded_msm, "sharded_window_sums_many", stall)
+    vs = make_verifiers(4, bad={1})
+    t0 = time.monotonic()
+    try:
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                     merge="never", mesh=MESH)
+    finally:
+        release.set()
+    assert verdicts == expected(4, bad={1})
+    stats = batch.last_run_stats
+    assert stats["device_sick"] and stats["host_batches"] == 4
+    assert batch._device_cooldown_until[0] > t0
+    assert batch._DeviceLane._instances.get(MESH) is None
+
+
+def test_mesh_probe_discard_on_host_overtake(monkeypatch):
+    """hybrid host lane drains the pool while the mesh probe is gated →
+    the probe chunk is discarded, verdicts all host, lane healthy."""
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    warm_mesh_shapes()
+    release = threading.Event()
+
+    def gated(digits, pts, n_devices):
+        release.wait(timeout=30.0)
+        raise RuntimeError("gated mesh call never completes")
+
+    monkeypatch.setattr(sharded_msm, "sharded_window_sums_many", gated)
+    discards = []
+    orig_discard = batch._DeviceLane.discard
+
+    def spy_discard(self, cid):
+        discards.append(cid)
+        return orig_discard(self, cid)
+
+    monkeypatch.setattr(batch._DeviceLane, "discard", spy_discard)
+    vs = make_verifiers(5, bad={4})
+    try:
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never",
+                                     mesh=MESH)
+    finally:
+        release.set()
+    assert verdicts == expected(5, bad={4})
+    stats = batch.last_run_stats
+    assert stats["host_batches"] == 5
+    assert stats["device_batches"] == 0
+    assert discards  # overtaken probe dropped
+    assert not stats["device_sick"]
+
+
 def test_verify_many_all_host_when_no_device_needed():
     """Sanity: the scheduler path with the real (CPU backend) kernel ends
     with every batch decided exactly once."""
