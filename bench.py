@@ -577,7 +577,10 @@ def main():
     device_only = None
     if backend == "device" and depth > 1:
         try:
-            device_only = measure_device_only(min(4, depth))
+            # 16 batches ≈ two full chunks after the padded probe — the
+            # steady-state per-chunk economics, not a half-empty-chunk
+            # penalty.
+            device_only = measure_device_only(min(16, depth))
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             device_only = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
 
@@ -587,9 +590,10 @@ def main():
         best = host_best
         backend = "host"
 
-    # Secondary isolated small-batch metrics (VERDICT r3 #3), host path.
+    # Secondary host-path metrics every round: the isolated small-batch
+    # configs (VERDICT r3 #3) + the structural adversarial mix (r3 #2).
     secondary = {}
-    for cfg in ("bench32", "cometbft128"):
+    for cfg in ("bench32", "cometbft128", "adversarial"):
         if cfg != args.config:
             try:
                 secondary[cfg] = measure_secondary(cfg)

@@ -1,0 +1,81 @@
+"""Production-shape device wire lab (round 4): cold start + per-call
+wall + H2D bytes for the compressed (33 B/term) vs affine (80 B/term)
+wires at the scheduler's real dispatch shape (chunk=8, N=12288).
+
+Run on the real TPU (no cpu forcing):
+
+    python tools/wire_lab.py [--chunk 8] [--sigs 10000] [--calls 4]
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--sigs", type=int, default=10_000)
+    ap.add_argument("--calls", type=int, default=4)
+    ap.add_argument("--wires", default="compressed,affine")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"# devices: {jax.devices()}", flush=True)
+    from ed25519_consensus_tpu import SigningKey, batch
+    from ed25519_consensus_tpu.ops import msm
+
+    rng = random.Random(0xBE7C)
+    bv = batch.Verifier()
+    keys = [SigningKey.new(rng) for _ in range(64)]
+    for i in range(args.sigs):
+        sk = keys[i % 64]
+        msg = b"wire-lab-%d" % i
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    staged = bv._stage(rng)
+    print(f"# staged {args.sigs} sigs -> {staged.n_device_terms} device "
+          f"terms", flush=True)
+
+    for wire in args.wires.split(","):
+        pad = msm.preferred_pad(staged.n_device_terms)
+        d, p = staged.device_operands(lambda n: pad, wire=wire)
+        dd = np.stack([d] * args.chunk)
+        pp = np.stack([p] * args.chunk)
+        mb = (dd.nbytes + pp.nbytes) / 1e6
+        print(f"## wire={wire}: operands {mb:.1f} MB/call "
+              f"(points {pp.nbytes/1e6:.1f} MB, digits "
+              f"{dd.nbytes/1e6:.1f} MB), shape B={args.chunk} N={pad}",
+              flush=True)
+        t0 = time.perf_counter()
+        # dispatch_window_sums_many serializes device entry itself
+        # (DEVICE_CALL_LOCK inside); np.asarray blocks on the fetch
+        out = np.asarray(msm.dispatch_window_sums_many(dd, pp))
+        t_first = time.perf_counter() - t0
+        print(f"#   first call (trace+compile+run): {t_first:.1f}s",
+              flush=True)
+        # verdict sanity on batch 0
+        check = msm.combine_window_sums(out[:1])
+        assert check.mul_by_cofactor().is_identity(), "batch must verify"
+        times = []
+        for _ in range(args.calls):
+            t0 = time.perf_counter()
+            np.asarray(msm.dispatch_window_sums_many(dd, pp))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        print(f"#   steady calls: {['%.2f' % t for t in times]} s -> "
+              f"best {best:.2f}s = {best*1000/args.chunk:.0f} ms/batch, "
+              f"eff. link {mb/best:.1f} MB/s if transfer-bound",
+              flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
