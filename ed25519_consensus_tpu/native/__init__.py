@@ -111,8 +111,13 @@ def load():
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p,  # shift_rows, prebuilt
         ]
         lib.verify_host_gid.restype = ctypes.c_int
+        lib.msm_shift128_row.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.msm_shift128_row.restype = None
+        lib.msm_build_table.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.msm_build_table.restype = None
         lib.bulk_challenges.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_char_p,
@@ -374,8 +379,31 @@ def stage_scalars_gid(s_buf, k_buf, z_blob, n: int,
     return b_acc, a_accs
 
 
+def msm_shift128_row(row128: bytes) -> bytes:
+    """[2^128]P raw row (projective) via 128 native doublings; None
+    without the native library."""
+    lib = load()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(128)
+    lib.msm_shift128_row(row128, out)
+    return out.raw
+
+
+def msm_build_table(row128: bytes) -> bytes:
+    """One term's 1440-byte plane-major Niels table (the per-key
+    coefficient table cache entry); None without the native library."""
+    lib = load()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(1440)
+    lib.msm_build_table(row128, out)
+    return out.raw
+
+
 def verify_host_batch(key_rows, r_buf, s_buf, k_buf, z_blob, n: int,
-                      gid_buf, m: int, b_row: bytes):
+                      gid_buf, m: int, b_row: bytes,
+                      shift_rows=None, prebuilt=None):
     """ONE native call for the whole host batch verification over the
     queue-order staging buffers: ZIP215 R decompression, s < ℓ checks,
     gid-routed coalescing, mod-ℓ coefficient reduction, the fused-block
@@ -391,7 +419,9 @@ def verify_host_batch(key_rows, r_buf, s_buf, k_buf, z_blob, n: int,
         return NotImplemented
     res = lib.verify_host_gid(
         _cbuf(key_rows), _cbuf(r_buf), _cbuf(s_buf), _cbuf(k_buf),
-        _cbuf(z_blob), n, _cbuf(gid_buf), m, b_row)
+        _cbuf(z_blob), n, _cbuf(gid_buf), m, b_row,
+        None if shift_rows is None else _cbuf(shift_rows),
+        None if prebuilt is None else _cbuf(prebuilt))
     if res < 0:
         return None
     return bool(res)

@@ -893,11 +893,17 @@ static const int NG = NDIG_PAD / 8;  // 9 window groups
 
 struct straus_ctx {
     ge8 acc[NG], acc2[NG];
+    // Highest window group any term touched: the Horner combine only
+    // needs windows < 8·max_groups (higher sums are identity — e.g.
+    // with 128-bit-split coefficients every scalar is < 2^129 and the
+    // combine shrinks from 65 windows to ≤ 40 automatically).
+    int max_groups;
 };
 
 IFMA_TARGET static void straus_ctx_init(straus_ctx &ctx) {
     const __m512i zero = _mm512_setzero_si512();
     const __m512i one = _mm512_set1_epi64(1);
+    ctx.max_groups = 1;
     for (int g = 0; g < NG; g++) {
         for (int i = 0; i < 5; i++) {
             ctx.acc[g].X.v[i] = zero;
@@ -950,6 +956,7 @@ IFMA_TARGET static void straus_accumulate8_block(const u64 *tables,
             if (any) break;
             ngroups--;
         }
+        if (ngroups > ctx.max_groups) ctx.max_groups = ngroups;
         for (int g = 0; g < ngroups; g++) {
             const int8_t *d = dig + 8 * g;
             __mmask8 negm = 0;
@@ -1128,6 +1135,29 @@ static void edwards_vartime_msm_chunk(const uint8_t *scalars,
     }
 }
 
+// Build ONE term's plane-major Niels table (TBL_STRIDE u64s = 1440 B)
+// with the scalar path — the per-key table-cache entry builder and the
+// fused MSM's scalar tail share this.
+static void build_table_row_scalar(const uint8_t *row128, u64 *out) {
+    ge p, e[9];
+    ge_frombytes128(p, row128);
+    ge_identity(e[0]);
+    e[1] = p;
+    for (int j = 2; j < 9; j++) ge_add(e[j], e[j - 1], p);
+    for (int j = 0; j < 9; j++) {
+        ge nf;
+        fe_sub(nf.X, e[j].Y, e[j].X);
+        fe_add(nf.Y, e[j].Y, e[j].X);
+        fe_add(nf.Z, e[j].Z, e[j].Z);
+        fe_mul(nf.T, e[j].T, FE_2D);
+        const fe *coords[4] = {&nf.X, &nf.Y, &nf.Z, &nf.T};
+        for (int cc = 0; cc < 4; cc++)
+            for (int l = 0; l < 5; l++)
+                out[(cc * 5 + l) * 9 + j] = coords[cc]->v[l];
+    }
+}
+
+
 #if defined(__x86_64__)
 // Fused-block IFMA MSM (round 4).  Round 3 ran two whole-batch passes —
 // build ALL multiples tables (1440 B/term: 14+ MB at 10k terms), then
@@ -1151,7 +1181,8 @@ static uint64_t msm_fb() {
 }
 
 static void ifma_msm(const uint8_t *scalars, const uint8_t *points,
-                     uint64_t n, ge &acc) {
+                     uint64_t n, ge &acc, const uint8_t *prebuilt,
+                     uint64_t n_prebuilt) {
     const uint64_t FB = msm_fb();
     // Grow-only holders, INTENTIONALLY immortal: a thread_local
     // destructor here runs during process/thread teardown interleaved
@@ -1193,35 +1224,24 @@ static void ifma_msm(const uint8_t *scalars, const uint8_t *points,
         const uint8_t *scs = scalars + 32 * off;
         u64 t_tbl = prof_now();
         uint64_t i0 = 0;
+        if (off < n_prebuilt) {
+            // Terms below n_prebuilt have caller-provided plane-major
+            // tables (the per-key cache): memcpy instead of rebuilding.
+            i0 = n_prebuilt - off < c ? n_prebuilt - off : c;
+            memcpy(tables,
+                   prebuilt + 8 * ifma::TBL_STRIDE * off,
+                   8 * ifma::TBL_STRIDE * i0);
+        }
         for (; i0 + 16 <= c; i0 += 16)
             ifma::table_build8_x2(pts + 128 * i0,
                                   tables + ifma::TBL_STRIDE * i0);
         for (; i0 + 8 <= c; i0 += 8)
             ifma::table_build8(pts + 128 * i0,
                                tables + ifma::TBL_STRIDE * i0);
-        for (uint64_t i = i0; i < c; i++) {
-            // scalar tail (< 8 terms): build extended entries, convert
-            // to the Niels form the IFMA accumulation reads
-            // ((Y-X, Y+X, 2Z, T*2d)), and write them PLANE-MAJOR:
-            // entry j of plane (coord, limb) at (coord·5+limb)·9 + j.
-            ge p, e[9];
-            ge_frombytes128(p, pts + 128 * i);
-            ge_identity(e[0]);
-            e[1] = p;
-            for (int j = 2; j < 9; j++) ge_add(e[j], e[j - 1], p);
-            u64 *row = tables + ifma::TBL_STRIDE * i;
-            for (int j = 0; j < 9; j++) {
-                ge nf;
-                fe_sub(nf.X, e[j].Y, e[j].X);
-                fe_add(nf.Y, e[j].Y, e[j].X);
-                fe_add(nf.Z, e[j].Z, e[j].Z);
-                fe_mul(nf.T, e[j].T, FE_2D);
-                const fe *coords[4] = {&nf.X, &nf.Y, &nf.Z, &nf.T};
-                for (int cc = 0; cc < 4; cc++)
-                    for (int l = 0; l < 5; l++)
-                        row[(cc * 5 + l) * 9 + j] = coords[cc]->v[l];
-            }
-        }
+        for (uint64_t i = i0; i < c; i++)
+            // scalar tail (< 8 terms), plane-major Niels rows
+            build_table_row_scalar(pts + 128 * i,
+                                   tables + ifma::TBL_STRIDE * i);
         for (uint64_t i = 0; i < c; i++)
             ifma::recode_signed64(scs + 32 * i,
                                   db.p + ifma::NDIG_PAD * i);
@@ -1233,11 +1253,13 @@ static void ifma_msm(const uint8_t *scalars, const uint8_t *points,
     }
     u64 t_h = prof_now();
     alignas(64) u64 sums[ifma::NDIG_PAD * 20];
+    int wmax = ctx.max_groups * 8 - 1;
+    if (wmax > 64) wmax = 64;
     ifma::straus_ctx_extract(ctx, sums);
     ge hacc;
     ge_identity(hacc);
-    for (int w = 64; w >= 0; w--) {
-        if (w != 64)
+    for (int w = wmax; w >= 0; w--) {
+        if (w != wmax)
             for (int k = 0; k < 4; k++) ge_double(hacc, hacc);
         ge s;
         memcpy(&s, sums + 20 * w, 160);
@@ -1249,15 +1271,19 @@ static void ifma_msm(const uint8_t *scalars, const uint8_t *points,
 #endif  // __x86_64__
 
 static void msm_into(ge &acc, const uint8_t *scalars,
-                     const uint8_t *points, uint64_t n) {
+                     const uint8_t *points, uint64_t n,
+                     const uint8_t *prebuilt = nullptr,
+                     uint64_t n_prebuilt = 0) {
     prof_msm_calls += 1;
     prof_msm_terms += n;
 #if defined(__x86_64__)
     if (ifma_available() && n >= 16) {
-        ifma_msm(scalars, points, n, acc);
+        ifma_msm(scalars, points, n, acc, prebuilt, n_prebuilt);
         return;
     }
 #endif
+    // The scalar fallback builds its own (16-entry extended) tables
+    // from the point rows; prebuilt Niels tables are simply unused.
     // Non-IFMA path: chunk so each chunk's 16-entry tables (2560 B/term)
     // stay cache-resident for the digit lookups.
     const uint64_t CHUNK = 10240;
@@ -1714,12 +1740,26 @@ static void sc_reduce_acc(const uint8_t acc56[56], uint8_t out[32]) {
 //   b_row: 128-byte raw basepoint row (X‖Y‖Z‖T canonical)
 // Returns 1 = batch valid, 0 = equation fails, -1 = rejected in staging
 // (bad R encoding or s ≥ ℓ) — the all-or-nothing semantics either way.
+// Split/prebuilt extension (round 4, small-batch fixed costs): with
+// `shift_rows` (the (1+m) raw rows of [2^128]B and the per-key
+// [2^128]A), every coefficient is SPLIT c = c_lo + 2^128·c_hi into two
+// ≤129-bit terms — all scalars then live in ≤ 33 radix-16 windows, so
+// the serial Horner combine shrinks from 65 windows to ≤ 40 (the
+// accumulate tracks the live maximum).  With `prebuilt` (the cached
+// plane-major Niels tables of the 2+2m coefficient points, built once
+// per key), the per-batch table build covers only the fresh R terms.
+// Both are NULL-able: batch.py supplies them only when every key's
+// entries are already cached (recurring validator sets), so fresh-key
+// one-shot workloads never pay the shift/table construction.
 int verify_host_gid(const uint8_t *key_rows, const uint8_t *rs,
                     const uint8_t *s_bytes, const uint8_t *k_bytes,
                     const uint8_t *z_bytes, uint64_t n,
                     const int32_t *gid, uint64_t m,
-                    const uint8_t *b_row) {
-    const uint64_t total = 1 + m + n;
+                    const uint8_t *b_row, const uint8_t *shift_rows,
+                    const uint8_t *prebuilt) {
+    const int split = shift_rows != nullptr;
+    const uint64_t head = split ? 2 + 2 * m : 1 + m;
+    const uint64_t total = head + n;
     // grow-only scratch, intentionally immortal (see ifma_msm)
     struct scratch_holder {
         uint8_t *p = nullptr;
@@ -1744,30 +1784,73 @@ int verify_host_gid(const uint8_t *key_rows, const uint8_t *rs,
     uint8_t *a_accs = grow::ensure(accs, 56 * (m ? m : 1));
 
     memcpy(points, b_row, 128);
-    memcpy(points + 128, key_rows, 128 * m);
-    zip215_decompress_batch(rs, n, points + 128 * (1 + m), ok, nullptr);
+    if (!split) {
+        memcpy(points + 128, key_rows, 128 * m);
+    } else {
+        memcpy(points + 128, shift_rows, 128);  // [2^128]B
+        for (uint64_t g = 0; g < m; g++) {
+            memcpy(points + 128 * (2 + 2 * g), key_rows + 128 * g, 128);
+            memcpy(points + 128 * (3 + 2 * g),
+                   shift_rows + 128 * (1 + g), 128);
+        }
+    }
+    zip215_decompress_batch(rs, n, points + 128 * head, ok, nullptr);
     for (uint64_t i = 0; i < n; i++)
         if (!ok[i]) return -1;
 
     u64 B[7];
     if (!stage_gid_core(s_bytes, k_bytes, z_bytes, n, gid, m, B, a_accs))
         return -1;
-    uint8_t b_red[32];
+    uint8_t b_red[32], coeff0[32];
     sc_reduce_acc((const uint8_t *)B, b_red);
-    sc_negate(b_red, scalars);  // coefficient 0: (−Σz·s) mod ℓ
-    for (uint64_t g = 0; g < m; g++)
-        sc_reduce_acc(a_accs + 56 * g, scalars + 32 * (1 + g));
-    memset(scalars + 32 * (1 + m), 0, 32 * n);
+    sc_negate(b_red, coeff0);  // coefficient 0: (−Σz·s) mod ℓ
+    if (!split) {
+        memcpy(scalars, coeff0, 32);
+        for (uint64_t g = 0; g < m; g++)
+            sc_reduce_acc(a_accs + 56 * g, scalars + 32 * (1 + g));
+    } else {
+        // c = c_lo + 2^128·c_hi: lo/hi 16-byte halves into adjacent
+        // zero-padded rows, matching the (P, [2^128]P) point pairs
+        auto write_split = [&](uint8_t *dst, const uint8_t c[32]) {
+            memcpy(dst, c, 16);
+            memset(dst + 16, 0, 16);
+            memcpy(dst + 32, c + 16, 16);
+            memset(dst + 48, 0, 16);
+        };
+        write_split(scalars, coeff0);
+        for (uint64_t g = 0; g < m; g++) {
+            uint8_t a_red[32];
+            sc_reduce_acc(a_accs + 56 * g, a_red);
+            write_split(scalars + 32 * (2 + 2 * g), a_red);
+        }
+    }
+    memset(scalars + 32 * head, 0, 32 * n);
     for (uint64_t i = 0; i < n; i++)
-        memcpy(scalars + 32 * (1 + m + i), z_bytes + 16 * i, 16);
+        memcpy(scalars + 32 * (head + i), z_bytes + 16 * i, 16);
 
     ge acc;
     ge_identity(acc);
-    msm_into(acc, scalars, points, total);
+    msm_into(acc, scalars, points, total, prebuilt,
+             prebuilt ? head : 0);
     ge_double(acc, acc);
     ge_double(acc, acc);
     ge_double(acc, acc);
     return (fe_iszero(acc.X) && fe_eq(acc.Y, acc.Z)) ? 1 : 0;
+}
+
+// [2^128]P for a raw 128-byte row: 128 doublings (the split-term shift
+// point; projective output — table building never needs Z = 1).
+void msm_shift128_row(const uint8_t *row128, uint8_t *out128) {
+    ge p;
+    ge_frombytes128(p, row128);
+    for (int i = 0; i < 128; i++) ge_double(p, p);
+    ge_tobytes128(out128, p);
+}
+
+// One term's plane-major Niels multiples table (1440 bytes) — the
+// per-key table-cache entry builder (see verify_host_gid's `prebuilt`).
+void msm_build_table(const uint8_t *row128, uint8_t *out1440) {
+    build_table_row_scalar(row128, (u64 *)out1440);
 }
 
 }  // extern "C"

@@ -191,6 +191,50 @@ def test_native_msm_niels_boundary_parity():
             edwards.multiscalar_mul(sc, pts), n
 
 
+def test_shift_row_and_split_path_parity():
+    """Round-4 split/prebuilt fast path: the native [2^128]P row matches
+    the exact host shift, and the fused verify with split coefficients +
+    prebuilt tables (engaged at a key's SECOND sight) decides identical
+    verdicts to the plain path, valid and tampered."""
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    from ed25519_consensus_tpu.ops.field import P
+
+    # native shift row == exact host [2^128]A (as group elements)
+    A = edwards.BASEPOINT.scalar_mul(rng.randrange(1, L))
+    row = b"".join((c % P).to_bytes(32, "little")
+                   for c in (A.X, A.Y, A.Z, A.T))
+    out = native.msm_shift128_row(row)
+    got = native.point_from_raw(out)
+    assert got == edwards.shift128(A)
+    assert len(native.msm_build_table(row)) == 1440
+
+    # second-sight policy: fresh keys -> no cache; repeat -> cached;
+    # third call runs the split path with correct verdicts
+    keys = [SigningKey.new(rng) for _ in range(5)]
+    kbs = {sk.verification_key_bytes().to_bytes() for sk in keys}
+    batch._host_split_cache.clear()
+    batch._seen_keys.difference_update(kbs)
+
+    def make(bad=False):
+        v = batch.Verifier()
+        for i, sk in enumerate(keys * 3):
+            msg = b"split-%d" % i
+            sig = sk.sign(msg if not (bad and i == 4) else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        return v
+
+    make().verify(rng=rng, backend="host")  # first sight: seen only
+    assert not kbs & set(batch._host_split_cache)
+    assert kbs <= batch._seen_keys
+    make().verify(rng=rng, backend="host")  # second sight: populated
+    assert kbs <= set(batch._host_split_cache)
+    for _ in range(3):  # split path now engaged: exactness both ways
+        make().verify(rng=rng, backend="host")
+        with pytest.raises(InvalidSignature):
+            make(bad=True).verify(rng=rng, backend="host")
+
+
 def test_bulk_challenges_parity_across_padding_boundaries():
     """Native SHA-512 + wide mod-ℓ reduction (bulk_challenges) must match
     hashlib + Python from_hash for every message length spanning the
