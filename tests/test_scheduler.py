@@ -212,8 +212,13 @@ def test_cooldown_skips_device_entirely(monkeypatch):
 
 
 def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
-    """A working-but-slow device that wins zero batches in a call of ≥8
-    batches arms the uncompetitive pause; the next call skips probing."""
+    """A working-but-slow device that wins zero batches arms a probing
+    pause — via the measured-uncompetitive branch when the probe's
+    timing resolves within the overtake grace, or via the
+    unresolved-probe streak when scheduling pressure discards the probe
+    before it starts (both are correct outcomes of the same design);
+    after at most _UNRESOLVED_PROBE_LIMIT calls the pause MUST be
+    armed, and the next call skips the device lane entirely."""
     warm_kernel_cache()
     real_dispatch = msm.dispatch_window_sums_many
 
@@ -222,16 +227,19 @@ def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
         return real_dispatch(digits, pts)
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", slow)
-    vs = make_verifiers(10, bad={1})
     t0 = time.monotonic()
-    verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
-    assert verdicts == expected(10, bad={1})
-    stats = dict(batch.last_run_stats)
-    assert not stats["device_sick"]
-    # the host (ms per batch) always overtakes a 0.75 s device probe
-    assert stats["device_batches"] == 0
+    for _ in range(batch._UNRESOLVED_PROBE_LIMIT):
+        vs = make_verifiers(10, bad={1})
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
+        assert verdicts == expected(10, bad={1})
+        stats = dict(batch.last_run_stats)
+        assert not stats["device_sick"]
+        # the host (ms per batch) always overtakes a 0.75 s device probe
+        assert stats["device_batches"] == 0
+        if batch._device_uncompetitive_until[0] > t0:
+            break
     assert batch._device_uncompetitive_until[0] > t0
-    # second call: pure host, no lane contact
+    # next call: pure host, no lane contact
 
     def fail_get(cls):
         raise AssertionError("probed during uncompetitive pause")
