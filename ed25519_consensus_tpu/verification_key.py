@@ -23,13 +23,14 @@ class VerificationKeyBytes:
     totally ordered so it can key maps (the batch verifier's coalescing
     groups by this type, reference src/batch.rs:112-118)."""
 
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, data):
         data = bytes(data)
         if len(data) != 32:
             raise InvalidSliceLength()
         self._bytes = data
+        self._hash = None
 
     @classmethod
     def from_bytes(cls, data) -> "VerificationKeyBytes":
@@ -60,7 +61,14 @@ class VerificationKeyBytes:
         return self._bytes <= other._bytes
 
     def __hash__(self):
-        return hash(self._bytes)
+        # Cached: the coalescing map hashes each key ~2× per queued
+        # signature, and stream workloads reuse the same key objects
+        # across every height (bytes are immutable, so this can never
+        # go stale).
+        h = self._hash
+        if h is None:
+            h = self._hash = hash(self._bytes)
+        return h
 
     def __repr__(self):
         return f"VerificationKeyBytes({self._bytes.hex()!r})"
