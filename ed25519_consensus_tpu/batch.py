@@ -1387,7 +1387,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # for a cooldown period.
     import os as _os
 
-    if (_os.environ.get("ED25519_TPU_DISABLE_DEVICE")
+    if (_os.environ.get("ED25519_TPU_DISABLE_DEVICE", "").lower()
+            in ("1", "true", "yes")  # explicit opt-outs only, like
+            #                          ED25519_TPU_DISABLE_NATIVE
             or _time.monotonic() < _device_cooldown_until[0]
             or _time.monotonic() < _device_uncompetitive_until[0]):
         # ED25519_TPU_DISABLE_DEVICE: config knob (SURVEY.md §5) forcing
