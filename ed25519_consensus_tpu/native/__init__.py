@@ -199,8 +199,12 @@ def _self_check(lib):
         raise RuntimeError("native check_prehashed disagreement")
     # bulk_challenges: SHA-512 + wide reduction must match hashlib +
     # Python from_hash on a multi-length message mix (incl. one spanning
-    # several 128-byte blocks).
-    msgs = [b"", b"native self check", b"x" * 300]
+    # several 128-byte blocks).  The leading 8 messages share a padded
+    # block count so the 8-way AVX-512 SHA-512 path is exercised AT LOAD
+    # on this machine's -march=native build (a miscompiled SIMD path
+    # must fail the self-check, not silently corrupt challenges).
+    msgs = [b"uniform-%03d" % i for i in range(8)]
+    msgs += [b"", b"native self check", b"x" * 300]
     ra = b"".join(
         bytes([i]) * 32 + bytes([0x80 | i]) * 32
         for i in range(len(msgs))

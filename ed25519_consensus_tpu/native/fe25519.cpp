@@ -1676,22 +1676,215 @@ static void sc_reduce_wide(const uint8_t in[64], uint8_t out[32]) {
     }
 }
 
+// ---- 8-way SHA-512 (AVX-512) --------------------------------------------
+// The challenge hash k = H(R‖A‖msg) is the queue-side floor: ~1.7 µs/sig
+// scalar (2+ compression blocks each).  SHA-512's round function is pure
+// 64-bit word arithmetic, so EIGHT independent messages ride the 8 u64
+// lanes of one zmm register: state words a..h become 8 vectors,
+// rotations are native (vprorq), and ch/maj collapse to one vpternlogq
+// each.  Messages are processed in groups of 8 with EQUAL padded block
+// counts (consensus streams have uniform message sizes; unequal tails
+// fall back to the scalar path).  Parity is pinned by the native
+// self-check and tests/test_native.py's padding-boundary fuzz.
+
+#if defined(__x86_64__)
+#define SHA8_TARGET \
+    __attribute__((target("avx512f,avx512bw,avx512dq,avx512vl")))
+
+namespace sha8 {
+
+SHA8_TARGET static inline __m512i ror(__m512i x, int n) {
+    return _mm512_ror_epi64(x, n);
+}
+
+// One 128-byte compression block for 8 lanes; `blk[l]` points at lane
+// l's (already padded) block bytes.
+SHA8_TARGET static void block8(__m512i st[8], const uint8_t *blk[8]) {
+    __m512i w[16];
+    for (int t = 0; t < 16; t++) {
+        alignas(64) u64 lane[8];
+        for (int l = 0; l < 8; l++) {
+            u64 v;
+            memcpy(&v, blk[l] + 8 * t, 8);
+            lane[l] = __builtin_bswap64(v);
+        }
+        w[t] = _mm512_load_si512((const void *)lane);
+    }
+    __m512i a = st[0], b = st[1], c = st[2], d = st[3];
+    __m512i e = st[4], f = st[5], g = st[6], h = st[7];
+    for (int t = 0; t < 80; t++) {
+        __m512i wt;
+        if (t < 16) {
+            wt = w[t & 15];
+        } else {
+            __m512i w15 = w[(t - 15) & 15], w2 = w[(t - 2) & 15];
+            __m512i s0 = _mm512_xor_si512(
+                _mm512_xor_si512(ror(w15, 1), ror(w15, 8)),
+                _mm512_srli_epi64(w15, 7));
+            __m512i s1 = _mm512_xor_si512(
+                _mm512_xor_si512(ror(w2, 19), ror(w2, 61)),
+                _mm512_srli_epi64(w2, 6));
+            wt = _mm512_add_epi64(
+                _mm512_add_epi64(w[t & 15], s0),
+                _mm512_add_epi64(w[(t - 7) & 15], s1));
+            w[t & 15] = wt;
+        }
+        __m512i S1 = _mm512_xor_si512(
+            _mm512_xor_si512(ror(e, 14), ror(e, 18)), ror(e, 41));
+        // ch(e,f,g) = (e&f) ^ (~e&g): vpternlogq imm 0xCA
+        __m512i ch = _mm512_ternarylogic_epi64(e, f, g, 0xCA);
+        __m512i t1 = _mm512_add_epi64(
+            _mm512_add_epi64(h, S1),
+            _mm512_add_epi64(
+                _mm512_add_epi64(ch, _mm512_set1_epi64(SHA512_K[t])),
+                wt));
+        __m512i S0 = _mm512_xor_si512(
+            _mm512_xor_si512(ror(a, 28), ror(a, 34)), ror(a, 39));
+        // maj(a,b,c) = (a&b) ^ (a&c) ^ (b&c): vpternlogq imm 0xE8
+        __m512i mj = _mm512_ternarylogic_epi64(a, b, c, 0xE8);
+        __m512i t2 = _mm512_add_epi64(S0, mj);
+        h = g; g = f; f = e;
+        e = _mm512_add_epi64(d, t1);
+        d = c; c = b; b = a;
+        a = _mm512_add_epi64(t1, t2);
+    }
+    st[0] = _mm512_add_epi64(st[0], a);
+    st[1] = _mm512_add_epi64(st[1], b);
+    st[2] = _mm512_add_epi64(st[2], c);
+    st[3] = _mm512_add_epi64(st[3], d);
+    st[4] = _mm512_add_epi64(st[4], e);
+    st[5] = _mm512_add_epi64(st[5], f);
+    st[6] = _mm512_add_epi64(st[6], g);
+    st[7] = _mm512_add_epi64(st[7], h);
+}
+
+// 8 hashes over equal-block-count inputs staged in `padded`
+// (8 × nblocks × 128 bytes, lane-major); big-endian digests out.
+SHA8_TARGET static void hash8(const uint8_t *padded, u64 nblocks,
+                              uint8_t out[8][64]) {
+    static const u64 IV[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+        0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+        0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+    __m512i st[8];
+    for (int i = 0; i < 8; i++)
+        st[i] = _mm512_set1_epi64((long long)IV[i]);
+    for (u64 b = 0; b < nblocks; b++) {
+        const uint8_t *blk[8];
+        for (int l = 0; l < 8; l++)
+            blk[l] = padded + (l * nblocks + b) * 128;
+        block8(st, blk);
+    }
+    alignas(64) u64 lanes[8][8];
+    for (int i = 0; i < 8; i++)
+        _mm512_store_si512((__m512i *)lanes[i], st[i]);
+    for (int l = 0; l < 8; l++)
+        for (int i = 0; i < 8; i++) {
+            u64 be = __builtin_bswap64(lanes[i][l]);
+            memcpy(out[l] + 8 * i, &be, 8);
+        }
+}
+
+}  // namespace sha8
+
+static bool sha8_available() {
+    static int avail = -1;
+    if (avail < 0)
+        avail = __builtin_cpu_supports("avx512f") &&
+                __builtin_cpu_supports("avx512bw") &&
+                __builtin_cpu_supports("avx512dq") &&
+                __builtin_cpu_supports("avx512vl");
+    return avail == 1;
+}
+#else
+static bool sha8_available() { return false; }
+#endif  // __x86_64__
+
+static void challenge_scalar(const uint8_t *ra, const uint8_t *msgs,
+                             const u64 *offsets, u64 i, uint8_t *k_out) {
+    uint8_t h[64];
+    const uint8_t *parts[3] = {ra + 64 * i, ra + 64 * i + 32,
+                               msgs + offsets[i]};
+    const size_t lens[3] = {32, 32,
+                            (size_t)(offsets[i + 1] - offsets[i])};
+    sha512(parts, lens, 3, h);
+    sc_reduce_wide(h, k_out + 32 * i);
+}
+
+
 extern "C" {
 
 // k_out[i] = SHA-512(ra[i*64 .. +32] ‖ ra[i*64+32 .. +32] ‖ msg_i) mod ℓ,
 // canonical 32-byte little-endian.  msgs is one concatenated buffer with
-// n+1 offsets.
+// n+1 offsets.  Runs 8 messages at a time through the AVX-512
+// multi-buffer SHA-512 when 8 consecutive messages share a padded block
+// count (consensus streams have uniform message sizes); scalar
+// otherwise.
 void bulk_challenges(const uint8_t *ra, const uint8_t *msgs,
                      const u64 *offsets, u64 n, uint8_t *k_out) {
-    for (u64 i = 0; i < n; i++) {
-        uint8_t h[64];
-        const uint8_t *parts[3] = {ra + 64 * i, ra + 64 * i + 32,
-                                   msgs + offsets[i]};
-        const size_t lens[3] = {32, 32,
-                                (size_t)(offsets[i + 1] - offsets[i])};
-        sha512(parts, lens, 3, h);
-        sc_reduce_wide(h, k_out + 32 * i);
+#if defined(__x86_64__)
+    if (sha8_available()) {
+        // grow-only padded-block staging, intentionally immortal (see
+        // ifma_msm for the teardown rationale)
+        struct pad_holder {
+            uint8_t *p = nullptr;
+            u64 cap = 0;
+        };
+        static thread_local pad_holder ph;
+        u64 i = 0;
+        while (i + 8 <= n) {
+            // total input length per lane: 64 (R‖A) + msg; padded
+            // blocks: len + 0x80 byte + 16-byte length field
+            u64 len0 = 64 + (offsets[i + 1] - offsets[i]);
+            u64 nblocks = (len0 + 1 + 16 + 127) / 128;
+            bool uniform = true;
+            for (int l = 1; l < 8; l++) {
+                u64 len = 64 + (offsets[i + l + 1] - offsets[i + l]);
+                if ((len + 1 + 16 + 127) / 128 != nblocks) {
+                    uniform = false;
+                    break;
+                }
+            }
+            if (!uniform) {
+                challenge_scalar(ra, msgs, offsets, i, k_out);
+                i++;
+                continue;
+            }
+            u64 need = 8 * nblocks * 128;
+            if (ph.cap < need) {
+                delete[] ph.p;
+                ph.p = nullptr;
+                ph.cap = 0;
+                ph.p = new uint8_t[need];
+                ph.cap = need;
+            }
+            for (int l = 0; l < 8; l++) {
+                uint8_t *dst = ph.p + l * nblocks * 128;
+                u64 mlen = offsets[i + l + 1] - offsets[i + l];
+                u64 len = 64 + mlen;
+                memcpy(dst, ra + 64 * (i + l), 64);
+                memcpy(dst + 64, msgs + offsets[i + l], mlen);
+                memset(dst + len, 0, nblocks * 128 - len);
+                dst[len] = 0x80;
+                u64 bits = len * 8;  // messages < 2^61 bytes
+                for (int j = 0; j < 8; j++)
+                    dst[nblocks * 128 - 8 + j] =
+                        (uint8_t)(bits >> (56 - 8 * j));
+            }
+            uint8_t out[8][64];
+            sha8::hash8(ph.p, nblocks, out);
+            for (int l = 0; l < 8; l++)
+                sc_reduce_wide(out[l], k_out + 32 * (i + l));
+            i += 8;
+        }
+        for (; i < n; i++)
+            challenge_scalar(ra, msgs, offsets, i, k_out);
+        return;
     }
+#endif
+    for (u64 i = 0; i < n; i++)
+        challenge_scalar(ra, msgs, offsets, i, k_out);
 }
 
 // (ℓ − b) mod ℓ for a reduced 32-byte scalar b < ℓ.
