@@ -449,7 +449,15 @@ class Verifier:
     def __init__(self):
         # vk_bytes -> list of (k, sig); insertion-ordered grouping is the
         # coalescing mechanism (reference HashMap, src/batch.rs:112-118).
-        self.signatures = {}
+        # LAZY since round 4: the map is the DIAGNOSTIC structure
+        # (bisection, per-item fallback, external inspection) — the
+        # all-valid fast paths verify straight from the flat queue-order
+        # buffers and never read it, so queued entries park in `_pending`
+        # (one tuple of parallel lists per queue_bulk call — O(calls),
+        # not O(sigs)) and materialize into `_sig_map` on first access
+        # through the `signatures` property.
+        self._sig_map = {}
+        self._pending = []
         self.batch_size = 0
         # Queue-order staging buffers (round 4): the flat per-signature
         # 32-byte slices (s, R, challenge) plus an int32 group id per
@@ -468,13 +476,48 @@ class Verifier:
         self._gid = _array.array("i")
         self._key_index = {}
 
+    @property
+    def signatures(self):
+        """The public coalescing map (vk_bytes -> [(k, sig), ...]),
+        materialized from the pending queue-order entries on first
+        access.  Mutating the returned dict (or assigning the
+        attribute) is supported — the queue-order buffers then fail
+        their size-consistency gate and staging falls back to the
+        grouped walk, exactly as before."""
+        if self._pending:
+            self._materialize()
+        return self._sig_map
+
+    @signatures.setter
+    def signatures(self, value):
+        # Direct assignment = external control of the map (tests, bench
+        # cloning): pending entries would double-count, so they clear;
+        # buffer staleness is handled by the size gates as always.
+        self._sig_map = value
+        self._pending = []
+
+    def _materialize(self) -> None:
+        """Fold `_pending` into `_sig_map`.  Each pending item is
+        (vkbs, sigs, ks) parallel sequences; `ks` is EITHER one packed
+        bytes-like of 32-byte canonical challenges (queue_bulk's native
+        blob) OR a list of per-entry challenges (ints from `queue`)."""
+        pending, self._pending = self._pending, []
+        sd = self._sig_map.setdefault
+        for vkbs, sigs, ks in pending:
+            if isinstance(ks, (bytes, bytearray, memoryview)):
+                kmv = memoryview(ks)
+                for i, (vkb, sig) in enumerate(zip(vkbs, sigs)):
+                    sd(vkb, []).append(
+                        (kmv[32 * i: 32 * i + 32], sig))
+            else:
+                for vkb, sig, k in zip(vkbs, sigs, ks):
+                    sd(vkb, []).append((k, sig))
+
     def queue(self, item) -> None:
         """Queue an `Item` or `(vk_bytes, sig, msg)` tuple (reference
         src/batch.rs:127-137)."""
         item = _as_item(item)
-        self.signatures.setdefault(item.vk_bytes, []).append(
-            (item.k, item.sig)
-        )
+        self._pending.append(((item.vk_bytes,), (item.sig,), (item.k,)))
         self.batch_size += 1
         ki = self._key_index
         self._gid.append(ki.setdefault(item.vk_bytes, len(ki)))
@@ -509,15 +552,16 @@ class Verifier:
             for vkb, sig, msg in zip(vkbs, sigs, msgs):
                 self.queue(Item.new(vkb, sig, msg))
             return
-        # Challenges stay as 32-byte canonical little-endian BYTES in the
-        # coalescing map (staging consumes bytes; int conversion on the
-        # hot queue path would cost ~0.8 µs/sig for nothing).
-        kmv = memoryview(kblob)
-        sd = self.signatures.setdefault
+        # Challenges stay as 32-byte canonical little-endian BYTES
+        # (staging consumes bytes; int conversion on the hot queue path
+        # would cost ~0.8 µs/sig for nothing).  The coalescing-map
+        # tuples are NOT built here: one pending triple records the
+        # whole call, and the map materializes only if something
+        # actually reads it (bisection, diagnostics).
+        self._pending.append((vkbs, sigs, kblob))
         ki = self._key_index
         gid_append = self._gid.append
-        for i, (vkb, sig) in enumerate(zip(vkbs, sigs)):
-            sd(vkb, []).append((kmv[32 * i: 32 * i + 32], sig))
+        for vkb in vkbs:
             gid_append(ki.setdefault(vkb, len(ki)))
         # bulk buffer appends: ra_parts already holds [R, A, R, A, ...],
         # so the R blob is one strided join — C-speed, not a per-item +=
@@ -549,17 +593,34 @@ class Verifier:
 
     def _buffers_live(self) -> bool:
         """True when every queue-order buffer is size-consistent with
-        the coalescing map — i.e. the verifier was populated through
+        the queued entries — i.e. the verifier was populated through
         queue/queue_bulk/merge_verifiers, not by direct `signatures`
         manipulation.  ALL four buffers are checked (a partially
         maintained clone must fall back, never feed native code a
-        short buffer)."""
+        short buffer).  Deliberately does NOT touch the `signatures`
+        property: the check must not force materialization of the
+        pending entries."""
         n = self.batch_size
-        return (len(self._s_buf) == 32 * n
+        if not (len(self._s_buf) == 32 * n
                 and len(self._r_buf) == 32 * n
                 and len(self._k_buf) == 32 * n
-                and len(self._gid) == n
-                and len(self._key_index) == len(self.signatures))
+                and len(self._gid) == n):
+            return False
+        if self._pending:
+            # Pending entries can only come from queue/queue_bulk (the
+            # property getter materializes before any external mutation
+            # and the setter clears pending), so the buffers are
+            # authoritative when the entry counts agree — AND every
+            # materialized-map key is one the queue path created (a
+            # stale reference to an earlier materialization could have
+            # been mutated count-neutrally; a foreign key is the
+            # detectable signature of that, same as the old key-count
+            # gate).
+            queued = sum(len(p[0]) for p in self._pending) + sum(
+                len(lst) for lst in self._sig_map.values())
+            return queued == n and all(
+                k in self._key_index for k in self._sig_map)
+        return len(self._key_index) == len(self._sig_map)
 
     def _stage_queue_order(self, rng) -> "StagedBatch":
         """Queue-order staging fast path (round 4): one native
@@ -736,9 +797,12 @@ class Verifier:
         t_start = _time.perf_counter()
         metrics.backend = backend
         metrics.batch_size = self.batch_size
-        metrics.distinct_keys = len(self.signatures)
         n = self.batch_size
-        if backend == "host" and n and self._buffers_live():
+        buffers_live = self._buffers_live()
+        # key count without forcing map materialization on the fast path
+        metrics.distinct_keys = (len(self._key_index) if buffers_live
+                                 else len(self.signatures))
+        if backend == "host" and n and buffers_live:
             # Fused host path: the WHOLE verification (decompression,
             # staging, MSM, cofactored identity check) is one native
             # call over the queue-order buffers — at reference-bench
@@ -1129,10 +1193,20 @@ def merge_verifiers(group) -> "Verifier":
     group = list(group)
     u = Verifier()
     buffers_ok = all(v._buffers_live() for v in group)
-    for v in group:
-        for vkb, sigs in v.signatures.items():
-            u.signatures.setdefault(vkb, []).extend(sigs)
-        u.batch_size += v.batch_size
+    if buffers_ok and all(not v._sig_map for v in group):
+        # Fully-lazy members: the union inherits their pending entry
+        # triples directly — O(queue calls), never materializing any
+        # member's map (the all-valid stream path reads no map at all).
+        # Triples are immutable-after-queueing, so sharing is safe; a
+        # union that later materializes builds its own fresh lists.
+        for v in group:
+            u._pending.extend(v._pending)
+            u.batch_size += v.batch_size
+    else:
+        for v in group:
+            for vkb, sigs in v.signatures.items():
+                u.signatures.setdefault(vkb, []).extend(sigs)
+            u.batch_size += v.batch_size
     if buffers_ok:
         ki = u._key_index
         for v in group:
