@@ -88,21 +88,9 @@ def build_batch(config: str, rng):
 
 def rebuild_fresh(bv):
     """Clone the queued signatures into a fresh Verifier (verification is
-    one-shot in spirit; staging cost must be measured every run).  The
-    queue-order staging buffers are cloned too — they are queue-TIME
-    artifacts, so a fresh verifier that received the same stream would
-    hold identical buffers; staging still runs in full every verify."""
-    from ed25519_consensus_tpu import batch
-
-    nv = batch.Verifier()
-    nv.signatures = {k: list(v) for k, v in bv.signatures.items()}
-    nv.batch_size = bv.batch_size
-    nv._s_buf = bytearray(bv._s_buf)
-    nv._r_buf = bytearray(bv._r_buf)
-    nv._k_buf = bytearray(bv._k_buf)
-    nv._gid = bv._gid[:]
-    nv._key_index = dict(bv._key_index)
-    return nv
+    one-shot in spirit; staging cost must be measured every run — the
+    clone keeps the fast staging path, see Verifier.clone)."""
+    return bv.clone()
 
 
 def build_stream_tuples(config: str, rng, n_batches: int):
@@ -344,7 +332,8 @@ def main():
     t0 = time.time()
     bv = build_batch(args.config, rng)
     n = bv.batch_size
-    print(f"# built {args.config}: {n} sigs, {len(bv.signatures)} keys "
+    print(f"# built {args.config}: {n} sigs, "
+          f"{bv.distinct_key_count} keys "
           f"in {time.time()-t0:.1f}s", file=sys.stderr)
 
     # Measure the PURE-HOST path FIRST, before anything imports jax: the

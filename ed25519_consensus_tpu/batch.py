@@ -458,6 +458,12 @@ class Verifier:
         # through the `signatures` property.
         self._sig_map = {}
         self._pending = []
+        # True once the map has been handed out (property get) or taken
+        # over (property set): an external reference can then mutate the
+        # dict COUNT-NEUTRALLY (swap a (k, sig) in place), which no size
+        # gate can see — so exposure itself retires the queue-order
+        # buffers and makes the map authoritative (grouped walk).
+        self._map_exposed = False
         self.batch_size = 0
         # Queue-order staging buffers (round 4): the flat per-signature
         # 32-byte slices (s, R, challenge) plus an int32 group id per
@@ -481,20 +487,61 @@ class Verifier:
         """The public coalescing map (vk_bytes -> [(k, sig), ...]),
         materialized from the pending queue-order entries on first
         access.  Mutating the returned dict (or assigning the
-        attribute) is supported — the queue-order buffers then fail
-        their size-consistency gate and staging falls back to the
-        grouped walk, exactly as before."""
+        attribute) is supported — and SOUND: handing the dict out at
+        all marks the queue-order buffers untrusted (`_map_exposed`),
+        so staging takes the grouped walk over the map from then on.
+        A size gate alone cannot catch a count-neutral in-place swap
+        of a (k, sig) entry; exposure can."""
+        m = self._materialized()
+        self._map_exposed = True
+        return m
+
+    @signatures.setter
+    def signatures(self, value):
+        # Direct assignment = external control of the map (tests,
+        # bisection plumbing): pending entries would double-count, so
+        # they clear; the assigner keeps a reference, so the map is
+        # exposed by definition and the buffers retire.
+        self._sig_map = value
+        self._pending = []
+        self._map_exposed = True
+
+    def _materialized(self):
+        """Internal view of the coalescing map: materializes pending
+        entries but does NOT mark the map exposed.  For in-package
+        readers that neither mutate the dict nor leak it — external
+        code must go through the `signatures` property."""
         if self._pending:
             self._materialize()
         return self._sig_map
 
-    @signatures.setter
-    def signatures(self, value):
-        # Direct assignment = external control of the map (tests, bench
-        # cloning): pending entries would double-count, so they clear;
-        # buffer staleness is handled by the size gates as always.
-        self._sig_map = value
-        self._pending = []
+    @property
+    def distinct_key_count(self) -> int:
+        """Number of distinct verification keys queued, WITHOUT exposing
+        the coalescing map (reading `signatures` retires the fast
+        staging path by design; this read-only accessor does not)."""
+        return (len(self._key_index) if self._buffers_live()
+                else len(self._materialized()))
+
+    def clone(self) -> "Verifier":
+        """An independent Verifier holding the same queued batch:
+        shared immutable pending triples, copied map lists, copied
+        queue-order buffers.  The clone is exactly what a fresh
+        verifier that received the same queue stream would hold, so it
+        keeps (or inherits the loss of) the fast staging path; an
+        exposed source taints its clones — the copied map could have
+        been mutated count-neutrally relative to the copied buffers."""
+        nv = Verifier()
+        nv._sig_map = {k: list(v) for k, v in self._sig_map.items()}
+        nv._pending = list(self._pending)
+        nv._map_exposed = self._map_exposed
+        nv.batch_size = self.batch_size
+        nv._s_buf = bytearray(self._s_buf)
+        nv._r_buf = bytearray(self._r_buf)
+        nv._k_buf = bytearray(self._k_buf)
+        nv._gid = self._gid[:]
+        nv._key_index = dict(self._key_index)
+        return nv
 
     def _materialize(self) -> None:
         """Fold `_pending` into `_sig_map`.  Each pending item is
@@ -600,6 +647,11 @@ class Verifier:
         short buffer).  Deliberately does NOT touch the `signatures`
         property: the check must not force materialization of the
         pending entries."""
+        if self._map_exposed:
+            # An external reference to the map exists: count-neutral
+            # in-place mutation is possible and undetectable by any
+            # size gate, so the map (grouped walk) is authoritative.
+            return False
         n = self.batch_size
         if not (len(self._s_buf) == 32 * n
                 and len(self._r_buf) == 32 * n
@@ -693,7 +745,7 @@ class Verifier:
         from . import native
         from .ops.scalar import L
 
-        groups = list(self.signatures.items())
+        groups = list(self._materialized().items())
         m = len(groups)
         n = self.batch_size
         # One batched (native if available, exact either way) decompression
@@ -800,8 +852,7 @@ class Verifier:
         n = self.batch_size
         buffers_live = self._buffers_live()
         # key count without forcing map materialization on the fast path
-        metrics.distinct_keys = (len(self._key_index) if buffers_live
-                                 else len(self.signatures))
+        metrics.distinct_keys = self.distinct_key_count
         if backend == "host" and n and buffers_live:
             # Fused host path: the WHOLE verification (decompression,
             # staging, MSM, cofactored identity check) is one native
@@ -1203,9 +1254,14 @@ def merge_verifiers(group) -> "Verifier":
             u._pending.extend(v._pending)
             u.batch_size += v.batch_size
     else:
+        # Internal views on both sides: reading a member for merging
+        # neither mutates nor leaks its dict (exposing it here would
+        # needlessly retire the member's own fast path), and the
+        # union's dict was never handed out at all.
+        um = u._materialized()
         for v in group:
-            for vkb, sigs in v.signatures.items():
-                u.signatures.setdefault(vkb, []).extend(sigs)
+            for vkb, sigs in v._materialized().items():
+                um.setdefault(vkb, []).extend(sigs)
             u.batch_size += v.batch_size
     if buffers_ok:
         ki = u._key_index

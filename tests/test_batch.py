@@ -146,7 +146,9 @@ def test_fused_host_path_agrees_with_staged_path(monkeypatch):
     for bad in (False, True):
         v = _mixed_verifier(bad=bad)
         v2 = batch.Verifier()  # dict-poked clone: grouped/staged path
-        v2.signatures = {k: list(s) for k, s in v.signatures.items()}
+        # _materialized(): reading via the property would mark v's map
+        # exposed and retire ITS fast path — the very thing under test.
+        v2.signatures = {k: list(s) for k, s in v._materialized().items()}
         v2.batch_size = v.batch_size
 
         def verdict(bv):
@@ -257,6 +259,53 @@ def test_queue_bulk_matches_queue():
         assert [as_int(x[0]) for x in a.signatures[k]] == \
                [as_int(x[0]) for x in b.signatures[k]]
     b.verify(rng=rng)
+
+
+def test_lazy_map_stays_pending_through_verify():
+    """Round-4 laziness invariant: the all-valid fast path must verify
+    straight from the flat queue-order buffers WITHOUT materializing the
+    public coalescing map; materialization happens only on first access
+    to `signatures`, and yields exactly the eager map."""
+    entries = []
+    for i in range(24):
+        sk = SigningKey.new(rng)
+        msg = b"lazy-%d" % i
+        entries.append((sk.verification_key_bytes(), sk.sign(msg), msg))
+    entries.append(entries[0])  # repeated entry exercises coalescing
+    bv = batch.Verifier()
+    bv.queue_bulk(entries)
+    assert bv._pending and not bv._sig_map
+    bv.verify(rng=rng)
+    assert bv._pending and not bv._sig_map  # verify never read the map
+    # Union of lazy verifiers inherits pending entries, stays lazy.
+    other = batch.Verifier()
+    sk = SigningKey.new(rng)
+    other.queue_bulk([(sk.verification_key_bytes(), sk.sign(b"u"), b"u")])
+    u = batch.merge_verifiers([bv, other])
+    assert u._pending and not u._sig_map
+    u.verify(rng=rng)
+    assert u._pending and not u._sig_map
+    # First access materializes, matching the eager per-item map.
+    eager = batch.Verifier()
+    for e in entries:
+        eager.queue(e)
+    assert list(u.signatures)[:len(eager.signatures)] == \
+        list(eager.signatures)
+    assert not u._pending
+    for k in eager.signatures:
+        assert [batch.challenge_int(x[0]) for x in u.signatures[k]][
+            :len(eager.signatures[k])] == \
+            [batch.challenge_int(x[0]) for x in eager.signatures[k]]
+    # Post-materialization poke: count-neutral tamper with a signature
+    # must still be caught (buffers go stale, grouped walk takes over).
+    vkb0 = next(iter(u.signatures))
+    k0, sig0 = u.signatures[vkb0][0]
+    from ed25519_consensus_tpu import Signature
+
+    bad = Signature(sig0.R_bytes, (99).to_bytes(32, "little"))
+    u.signatures[vkb0][0] = (k0, bad)
+    with pytest.raises(InvalidSignature):
+        u.verify(rng=rng)
 
 
 def test_queue_bulk_fallback_without_native(monkeypatch):

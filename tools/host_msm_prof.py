@@ -33,7 +33,8 @@ def main():
     t0 = time.perf_counter()
     bv = bench.build_batch(args.config, rng)
     n = bv.batch_size
-    print(f"# built {args.config}: {n} sigs, {len(bv.signatures)} keys "
+    print(f"# built {args.config}: {n} sigs, "
+          f"{bv.distinct_key_count} keys "
           f"in {time.perf_counter()-t0:.1f}s "
           f"(FB={os.environ.get('ED25519_TPU_MSM_FB', 'default')})",
           flush=True)
