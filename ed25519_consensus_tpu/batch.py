@@ -168,6 +168,17 @@ def _device_wire_mode() -> str:
     return v if v in ("compressed", "affine") else "compressed"
 
 
+def _device_digit_wire() -> str:
+    """Digit wire A/B knob (`ED25519_TPU_DIGIT_WIRE`): `packed`
+    (default) ships two signed radix-16 digits per byte — 17 B/term
+    instead of 33, unpacked in-jit (ops/msm.py expand_digits); `plain`
+    is the one-digit-per-byte round-3 format."""
+    import os
+
+    v = os.environ.get("ED25519_TPU_DIGIT_WIRE", "packed").lower()
+    return v if v in ("packed", "plain") else "packed"
+
+
 # Decompressed RAW key rows (canonical X‖Y‖Z‖T, 128 bytes) keyed by the
 # 32-byte encoding.  Deterministic from the encoding, so entries can
 # never go stale; consensus workloads re-see the same validator keys
@@ -366,8 +377,10 @@ class StagedBatch:
         return native.vartime_msm_scblob(sblob, self.raw_points)
 
     def device_operands(self, pad_fn, wire: "str | None" = None):
-        """Build the padded device operands: signed digit planes
-        (NWINDOWS, N) int8 plus the point wire —
+        """Build the padded device operands: signed digit planes —
+        (PACKED_WINDOWS, N) uint8 nibble-packed by default (the uint8
+        dtype IS the format tag), (NWINDOWS, N) int8 with
+        ED25519_TPU_DIGIT_WIRE=plain — plus the point wire —
 
         * `compressed` (default when staging captured encodings): a
           (33, N) uint8 array of 32-byte y encodings + flip/neg hint
@@ -378,7 +391,9 @@ class StagedBatch:
 
         Coefficients split into 128-bit chunks against their cached
         shift points; blinder digits packed vectorized from the raw
-        buffers.  Term order: [coeffs..., split-highs..., R's...]."""
+        buffers, then (digit wire `packed`, the default) nibble-packed
+        to 17 B/term.  Term order: [coeffs..., split-highs...,
+        R's...]."""
         from .ops import limbs
 
         if wire is None:
@@ -406,6 +421,8 @@ class StagedBatch:
                 self.n_sigs, 16
             )
             digits[:, n_head:n] = limbs.pack_u128_windows(zb)
+        if _device_digit_wire() == "packed":
+            digits = limbs.pack_digit_planes(digits)
         if wire == "compressed":
             m = n_coeff - 1  # distinct keys among the coefficient terms
             w = limbs.identity_wire_batch(N)
@@ -1486,7 +1503,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
 
             nb = chunk - digits.shape[0]
             digits = np.concatenate(
-                [digits, np.zeros((nb,) + digits.shape[1:], np.int8)]
+                [digits, np.zeros((nb,) + digits.shape[1:],
+                                  digits.dtype)]  # dtype tags the wire
             )
             mk_ident = {2: limbs.identity_affine_batch,
                         33: limbs.identity_wire_batch}.get(

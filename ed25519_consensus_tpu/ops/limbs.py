@@ -100,28 +100,56 @@ def pack_scalar_bits(scalars, nbits: int = SCALAR_BITS) -> np.ndarray:
 
 WINDOW_BITS = 4
 # Signed radix-16: 32 nibble windows for the uniform 128-bit scalars plus
-# one carry window from the signed recoding (digits in [-8, 8]).
+# one carry window from the signed recoding.  Digits live in [-8, 7]
+# (carry at v ≥ 8) so every digit fits a SIGNED NIBBLE — that is what
+# lets the device wire pack two digits per byte (pack_digit_planes);
+# the kernels' [0..8]P multiples tables are unaffected (|d| ≤ 8 still).
 NWINDOWS = 33
+PACKED_WINDOWS = (NWINDOWS + 1) // 2  # nibble-packed digit planes
 
 
 def _recode_signed(d_le: np.ndarray) -> np.ndarray:
     """Unsigned little-endian nibble digits (n, W) → signed digits
-    (n, W+1) int8 with every digit in [-8, 8]: d > 8 becomes d - 16 with a
-    carry into the next window (vectorized over the batch)."""
+    (n, W+1) int8 with every digit in [-8, 7]: d ≥ 8 becomes d - 16 with
+    a carry into the next window (vectorized over the batch)."""
     n, W = d_le.shape
     out = np.zeros((n, W + 1), dtype=np.int8)
     carry = np.zeros(n, dtype=np.int32)
     for w in range(W):
         v = d_le[:, w].astype(np.int32) + carry
-        carry = (v > 8).astype(np.int32)
+        carry = (v >= 8).astype(np.int32)
         out[:, w] = (v - 16 * carry).astype(np.int8)
     out[:, W] = carry.astype(np.int8)
     return out
 
 
+def pack_digit_planes(digits: np.ndarray) -> np.ndarray:
+    """Nibble-pack signed digit planes for the device wire: (NWINDOWS, N)
+    int8 with digits in [-8, 7] → (PACKED_WINDOWS, N) uint8, halving the
+    digit transfer.  Packed row w carries plane 2w in its LOW nibble and
+    plane 2w+1 in its HIGH nibble; the odd final plane (the carry
+    window) rides alone in the last packed row's low nibble.  The uint8
+    dtype IS the format tag (plain planes are int8) — window counts
+    alone would be ambiguous, e.g. 64-bit scalars pack to 17 plain
+    planes.  Inverse: ops.msm.expand_digits (in-jit, so only packed
+    bytes cross the link)."""
+    W, n = digits.shape
+    if W != NWINDOWS:
+        # expand_digits hardcodes the 33-plane layout; packing any other
+        # plane count would decode to garbage, so fail loudly instead.
+        raise ValueError(f"pack_digit_planes needs {NWINDOWS} planes, "
+                         f"got {W}")
+    d = digits.astype(np.int32) & 0xF
+    packed = np.zeros((PACKED_WINDOWS, n), dtype=np.uint8)
+    packed[: W // 2] = (d[1::2] << 4) | d[0:-1:2]
+    if W % 2:
+        packed[-1] = d[-1]
+    return packed
+
+
 def pack_scalar_windows(scalars, nwindows: int = NWINDOWS) -> np.ndarray:
     """Pack scalars (< 16^(nwindows-1)) into MSB-first SIGNED radix-16
-    digit planes (nwindows, N) int8, digits in [-8, 8] (vectorized via
+    digit planes (nwindows, N) int8, digits in [-8, 7] (vectorized via
     np.unpackbits + carry recoding)."""
     nub = nwindows - 1  # unsigned nibble windows before recoding
     nbytes = (nub * WINDOW_BITS + 7) // 8

@@ -12,7 +12,7 @@ by batch.py).  That halves the window count of the whole MSM: 32 radix-16
 windows instead of 64.
 
 Each 128-bit scalar is recoded to NWINDOWS = 33 MSB-first SIGNED radix-16
-digits d_{i,w} ∈ [-8, 8] (limbs.py):
+digits d_{i,w} ∈ [-8, 7] (limbs.py):
 
     Σ_i [c_i]P_i  =  Σ_w 16^(32-w) · S_w,    S_w = Σ_i [d_{i,w}] T_i
 
@@ -166,7 +166,7 @@ def split_terms(scalars, points, shifts=None):
 def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
     """Build and jit the windowed per-window-sum kernel for a fixed lane
     count.
-    Input: digits (nwin, N) int8, SIGNED digits in [-8, 8], MSB-first;
+    Input: digits (nwin, N) int8, SIGNED digits in [-8, 7], MSB-first;
            points (4, NLIMBS, N) int16.
     Output: (4, NLIMBS, nwin) int32 — the per-window sums S_w."""
     ensure_compile_cache()
@@ -387,32 +387,77 @@ def expand_points_single(points, wire: str):
     return expand_points(points[None], wire)[0]
 
 
+# Digit wire formats (the DTYPE is the tag — window counts alone are
+# ambiguous: 64-bit scalars pack to 17 plain planes, the same count as
+# the packed form of 128-bit scalars):
+#   "plain"   (..., NWINDOWS, N) int8 — one signed digit per byte
+#   "packed"  (..., PACKED_WINDOWS, N) uint8 — two signed nibbles per
+#             byte (limbs.pack_digit_planes); unpacked in-jit, so only
+#             17 B/term of digits cross the link instead of 33.
+def digit_wire_of(digits) -> str:
+    return "packed" if digits.dtype == np.uint8 else "plain"
+
+
+def logical_windows(digits, axis: int = -2) -> int:
+    """The kernel-visible window count for a digit array in either wire
+    format: packed planes always decode to NWINDOWS; plain planes carry
+    their count on the given axis.  Every dispatch site derives nwin
+    through this one rule."""
+    return (NWINDOWS if digit_wire_of(digits) == "packed"
+            else digits.shape[axis])
+
+
+def expand_digits(digits):
+    """In-jit unpack of nibble-packed digit planes: uint8
+    (..., PACKED_WINDOWS, N) → (..., NWINDOWS, N) int8 signed digits in
+    [-8, 7].  Packed row w holds plane 2w in its low nibble and plane
+    2w+1 in its high nibble; the final carry plane rides alone
+    (limbs.pack_digit_planes is the host-side inverse)."""
+    import jax.numpy as jnp
+
+    x = digits.astype(jnp.int32)
+    lo = ((x & 0xF) ^ 8) - 8           # sign-extended low nibble
+    hi = (((x >> 4) & 0xF) ^ 8) - 8    # sign-extended high nibble
+    half = NWINDOWS // 2               # 16 full pairs
+    pair = jnp.stack([lo[..., :half, :], hi[..., :half, :]], axis=-2)
+    head = pair.reshape(x.shape[:-2] + (2 * half, x.shape[-1]))
+    return jnp.concatenate(
+        [head, lo[..., half:, :]], axis=-2).astype(jnp.int8)
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_kernel_many(n_batches: int, n_lanes: int,
-                          nwin: int = NWINDOWS, wire: str = "extended"):
+                          nwin: int = NWINDOWS, wire: str = "extended",
+                          dwire: str = "plain"):
     """vmap of the XLA scan kernel over a leading batch axis: B independent
     verification batches in ONE device call (the per-call tunnel round-trip
-    dominates on remote-attached devices).  Non-extended `wire` formats
-    are expanded on-device inside the same jit."""
+    dominates on remote-attached devices).  Non-extended `wire` point
+    formats and `packed` digit planes are expanded on-device inside the
+    same jit."""
     import jax
 
     kernel = _compiled_kernel.__wrapped__(n_lanes, nwin)
     vk = jax.vmap(kernel)
-    if wire == "extended":
+    if wire == "extended" and dwire == "plain":
         return jax.jit(vk)
 
     def f(digits, pts):
+        if dwire == "packed":
+            digits = expand_digits(digits)
         return vk(digits, expand_points(pts, wire))
 
     return jax.jit(f)
 
 
 def dispatch_window_sums_many(digits, points):
-    """One device call for B stacked batches: digits (B, NWINDOWS, N),
-    points in any wire format (see wire_of; expansion happens on-device)
+    """One device call for B stacked batches: digits (B, NWINDOWS, N)
+    plain or (B, PACKED_WINDOWS, N) nibble-packed, points in any wire
+    format (see wire_of / digit_wire_of; expansion happens on-device)
     → (B, 4, NLIMBS, NWINDOWS) device array with its D2H copy in
     flight."""
     wire = wire_of(points)
+    dwire = digit_wire_of(digits)
+    nwin = logical_windows(digits)
     with DEVICE_CALL_LOCK:
         if _use_pallas():
             from . import pallas_msm
@@ -420,8 +465,8 @@ def dispatch_window_sums_many(digits, points):
             out = pallas_msm.pallas_window_sums_many(digits, points)
         else:
             out = _compiled_kernel_many(digits.shape[0], digits.shape[2],
-                                        digits.shape[1],
-                                        wire=wire)(digits, points)
+                                        nwin, wire=wire,
+                                        dwire=dwire)(digits, points)
         try:
             out.copy_to_host_async()
         except AttributeError:

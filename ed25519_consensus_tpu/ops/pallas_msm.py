@@ -6,7 +6,7 @@ per-window sums), hand-blocked for the VPU:
 * **(32, 128) lane tiles.**  Every limb value in the kernel is a full
   (sublane × lane) int32 tile — 1-D vectors would use 1 of 8 sublanes.
   A grid step processes a block of G = 4096 terms.
-* **Signed radix-16 digits** (limbs.py recoding, d ∈ [-8, 8], 33 windows):
+* **Signed radix-16 digits** (limbs.py recoding, d ∈ [-8, 7], 33 windows):
   the multiples table is 9 entries ([0..8]P) instead of 16 — half the
   table-build point-adds and half the select masks; negation is free in
   the balanced-limb representation (negate X and T limbs).
@@ -312,7 +312,8 @@ def _body_style() -> str:
 def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
                        interpret: bool = False, tile=(SUBLANES, LANES),
                        tbl_dtype="int16", win_chunk: int = 1,
-                       body: str | None = None, wire: str = "extended"):
+                       body: str | None = None, wire: str = "extended",
+                       dwire: str = "plain"):
     """ONE jitted function for the whole device step: Pallas partial-sum
     kernel + XLA fold of the per-block partials, so a multi-batch
     verification is a single tunnel call.
@@ -336,6 +337,10 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     fS = min(FOLD_SUBLANES, S)
 
     def pipeline(digits, points):
+        if dwire == "packed":
+            from .msm import expand_digits
+
+            digits = expand_digits(digits)
         if wire != "extended":
             from .msm import expand_points
 
@@ -407,21 +412,25 @@ def pallas_window_sums_many(digits, points, interpret: bool = False,
                             tile=(SUBLANES, LANES), tbl_dtype="int16",
                             win_chunk: int | None = None,
                             body: str | None = None):
-    """Batched dispatch: digits (B, nwin, N) int8, points (B, 4, NLIMBS, N)
+    """Batched dispatch: digits (B, nwin, N) int8 (plain or
+    nibble-packed — see msm.digit_wire_of), points (B, 4, NLIMBS, N)
     int16 numpy arrays → (B, 4, NLIMBS, nwin) device array, one device
     call."""
-    B, nwin, N = digits.shape
+    from .msm import digit_wire_of, logical_windows, wire_of
+
+    B, _, N = digits.shape
+    dwire = digit_wire_of(digits)
+    nwin = logical_windows(digits)
     if win_chunk is None:
         win_chunk = _auto_win_chunk(nwin)
     if body is None:
         body = _body_style()  # resolved here so the env is re-read per call
-    from .msm import wire_of
-
     return _compiled_pipeline(B, N, nwin, interpret=interpret, tile=tile,
                               tbl_dtype=tbl_dtype,
                               win_chunk=win_chunk,
                               body=body,
-                              wire=wire_of(points))(digits, points)
+                              wire=wire_of(points),
+                              dwire=dwire)(digits, points)
 
 
 def pallas_window_sums(digits, points, interpret: bool = False,

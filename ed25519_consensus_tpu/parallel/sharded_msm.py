@@ -28,7 +28,8 @@ from . import mesh as mesh_lib
 
 @functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
-                             nwin: int, wire: str = "extended"):
+                             nwin: int, wire: str = "extended",
+                             dwire: str = "plain"):
     """jit a shard_map'd MSM over a 1-D batch mesh.
 
     Input shapes (global): digits (nwin, N), points in any wire format
@@ -56,6 +57,10 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
 
     def shard_fn(digits, points):
         # Per-device shard: (nwin, N/D) + the wire's point shard
+        # (packed digit planes unpack per-shard too, so ICI/H2D ships
+        # 17 B/term of digits, not 33)
+        if dwire == "packed":
+            digits = msm_lib.expand_digits(digits)
         if wire != "extended":
             points = msm_lib.expand_points_single(points, wire)
         part = local_kernel(digits, points)  # (4, NLIMBS, nwin)
@@ -87,7 +92,8 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
 @functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
                                   lanes_per_device: int, nwin: int,
-                                  wire: str = "extended"):
+                                  wire: str = "extended",
+                                  dwire: str = "plain"):
     """Batched mesh kernel for the throughput scheduler: B stacked
     verification batches, each one's MSM terms sharded over the device
     mesh, partial Edwards sums all-gathered and folded per batch — one
@@ -116,6 +122,8 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
 
     def shard_fn(digits, points):
         # per-device: (B, nwin, N/D) + the wire's point shard
+        if dwire == "packed":
+            digits = msm_lib.expand_digits(digits)
         if wire != "extended":
             points = msm_lib.expand_points(points, wire)
         part = jax.vmap(local_kernel)(digits, points)  # (B,4,NLIMBS,nwin)
@@ -147,9 +155,11 @@ def sharded_window_sums_many(digits, pts, n_devices: int):
     """Batched mesh dispatch (the scheduler's device-lane call when a
     mesh is configured): digits (B, nwin, N), points in any wire format
     → (B, 4, NLIMBS, nwin) device array."""
+    dwire = msm_lib.digit_wire_of(digits)
+    nwin = msm_lib.logical_windows(digits)
     return _compiled_sharded_kernel_many(
         n_devices, digits.shape[0], digits.shape[2] // n_devices,
-        digits.shape[1], wire=msm_lib.wire_of(pts),
+        nwin, wire=msm_lib.wire_of(pts), dwire=dwire,
     )(digits, pts)
 
 
@@ -172,9 +182,11 @@ def sharded_window_sums(digits, pts, n_devices: int):
     """Dispatch pre-packed operands over the mesh; returns the replicated
     (4, NLIMBS, nwin) window sums as a device array.  Points in any
     wire format (unbatched: (4|2, NLIMBS, N) limbs or (33, N) uint8)."""
+    dwire = msm_lib.digit_wire_of(digits)
+    nwin = msm_lib.logical_windows(digits, axis=0)
     kernel, _ = _compiled_sharded_kernel(
-        n_devices, digits.shape[1] // n_devices, digits.shape[0],
-        wire=msm_lib.wire_of(pts[None]),
+        n_devices, digits.shape[1] // n_devices, nwin,
+        wire=msm_lib.wire_of(pts[None]), dwire=dwire,
     )
     return kernel(digits, pts)
 

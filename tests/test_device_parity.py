@@ -135,6 +135,37 @@ def test_compressed_wire_matches_affine_wire():
     assert got_c == staged.host_msm()
 
 
+def test_packed_digit_wire_matches_plain(monkeypatch):
+    """Round-4 nibble-packed digit wire (17 B/term) vs the plain
+    one-digit-per-byte planes: the SAME staged batch dispatched through
+    both digit formats must yield identical window sums — covering the
+    in-jit expand (ops/msm.py expand_digits) over split coefficient
+    terms, full-width scalars, and zero padding lanes."""
+    from ed25519_consensus_tpu.ops import limbs, msm
+
+    bv = batch.Verifier()
+    keys = [SigningKey.new(rng) for _ in range(3)]
+    for i in range(130):  # >128 distinct keys exercises split-high terms
+        sk = keys[i % 3] if i < 6 else SigningKey.new(rng)
+        msg = b"digit wire %d" % i
+        bv.queue((sk.verification_key_bytes(), sk.sign(msg), msg))
+    staged = bv._stage(random.Random(7))
+    monkeypatch.setenv("ED25519_TPU_DIGIT_WIRE", "plain")
+    dig_p, pts_p = staged.device_operands(msm.preferred_pad)
+    monkeypatch.setenv("ED25519_TPU_DIGIT_WIRE", "packed")
+    dig_k, pts_k = staged.device_operands(msm.preferred_pad)
+    assert dig_p.shape[0] == limbs.NWINDOWS
+    assert dig_k.shape[0] == limbs.PACKED_WINDOWS
+    assert msm.digit_wire_of(dig_p) == "plain"
+    assert msm.digit_wire_of(dig_k) == "packed"
+    # host-side inverse agrees bit-exactly
+    assert np.array_equal(np.asarray(msm.expand_digits(dig_k)), dig_p)
+    out_p = np.asarray(msm.dispatch_window_sums(dig_p, pts_p))
+    out_k = np.asarray(msm.dispatch_window_sums(dig_k, pts_k))
+    assert np.array_equal(out_p, out_k)
+    assert msm.combine_window_sums(out_k) == staged.host_msm()
+
+
 def test_verify_many_pad_covers_split_terms():
     """verify_many must size the common lane pad from the count INCLUDING
     the 128-bit split-high terms (regression: 130 distinct-key sigs made

@@ -37,6 +37,31 @@ def test_signed_recode_roundtrip():
         assert _digits_value(planes, j) == c, hex(c)
 
 
+def test_digit_nibble_packing_roundtrip():
+    """The packed digit wire: every signed digit must fit a nibble
+    ([-8, 7] — guaranteed by the ≥8 carry in the recoding), and
+    pack_digit_planes must be exactly inverted by ops.msm.expand_digits
+    (including the lone carry plane in the last packed row)."""
+    from ed25519_consensus_tpu.ops import msm
+
+    cases = [0, 1, 7, 8, 15, 16, (1 << 128) - 1, (1 << 128) - 8,
+             0x88888888888888888888888888888888]
+    cases += [rng.randrange(1 << 128) for _ in range(96)]
+    planes = limbs.pack_scalar_windows(cases)
+    assert int(planes.min()) >= -8 and int(planes.max()) <= 7
+    packed = limbs.pack_digit_planes(planes)
+    assert packed.shape == (limbs.PACKED_WINDOWS, len(cases))
+    assert packed.dtype == np.uint8  # the dtype IS the wire tag
+    # a 17-plane PLAIN packing (64-bit scalars) must NOT be mistaken
+    # for the packed wire — the shapes collide, the dtypes don't
+    plain17 = limbs.pack_scalar_windows(
+        [rng.randrange(1 << 64) for _ in range(4)], nwindows=17)
+    assert msm.digit_wire_of(plain17) == "plain"
+    assert msm.digit_wire_of(packed) == "packed"
+    back = np.asarray(msm.expand_digits(packed))
+    assert np.array_equal(back, planes)
+
+
 def test_u128_window_packing_matches_scalar_packing():
     zs = [rng.randrange(1 << 128) for _ in range(40)] + [0, 1, (1 << 128) - 1]
     zb = np.frombuffer(
