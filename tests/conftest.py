@@ -33,3 +33,33 @@ def _shutdown_device_lane_at_session_end():
     from ed25519_consensus_tpu import batch
 
     batch._DeviceLane.reset_all()
+
+    # Release compiled-executable state Python-side, in a controlled
+    # order, while the runtime is fully alive — instead of leaving ~100
+    # resident XLA executables to interpreter finalization.  The
+    # round-2 teardown heap corruption (glibc "corrupted size vs.
+    # prev_size" at exit) is an upstream finalization-order hazard that
+    # recurred ONCE at round-4 HEAD (1 of 2 otherwise-identical runs,
+    # suites green both times); dropping the references early shrinks
+    # the state the fragile finalization sequence walks.  This does NOT
+    # mask the regression check — the glibc consolidation still runs at
+    # exit and still aborts if the heap was stomped.
+    import gc
+
+    from ed25519_consensus_tpu.ops import msm, pallas_msm
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    # Sweep every lru_cache in the kernel modules rather than naming
+    # them — a hardcoded list would silently drift as rounds add
+    # compiled kernels, quietly un-mitigating the very hazard this
+    # block exists for.
+    for mod in (msm, pallas_msm, sharded_msm):
+        for attr in vars(mod).values():
+            clear = getattr(attr, "cache_clear", None)
+            if callable(clear):
+                clear()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
