@@ -573,6 +573,52 @@ def msm_profile_reset() -> None:
         lib.msm_prof_reset()
 
 
+_B_ROW128 = None
+
+
+def basepoint_row128() -> bytes:
+    """Cached 128-byte canonical raw row of the basepoint (the
+    `_point128` format); constant, computed once."""
+    global _B_ROW128
+    if _B_ROW128 is None:
+        from ..ops import edwards
+
+        _B_ROW128 = _point128(edwards.BASEPOINT)
+    return _B_ROW128
+
+
+def point_row128(pt) -> bytes:
+    """Public alias for the canonical 128-byte X‖Y‖Z‖T row serializer
+    (callers cache rows of long-lived points, e.g. a key's −A)."""
+    return _point128(pt)
+
+
+def check_prehashed_rows(mA_row: bytes, R_enc, k: int, s: int):
+    """Row-based single-verify hot path: −A as its cached 128-byte raw
+    row, R as the 32-byte wire encoding — decompressed natively straight
+    into the equation check, with NO Python Point construction anywhere.
+    Returns False on undecompressable R or a failed cofactored equation,
+    True on success; NotImplemented without the native library (caller
+    falls back to the Point-based `check_prehashed`)."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    out = ctypes.create_string_buffer(128)
+    okb = ctypes.create_string_buffer(1)
+    lib.zip215_decompress_batch(bytes(R_enc), 1, out, okb, None)
+    if okb.raw[0] == 0:
+        return False
+    return bool(
+        lib.zip215_check_prehashed(
+            mA_row,
+            out.raw,
+            basepoint_row128(),
+            int(k).to_bytes(32, "little"),
+            int(s).to_bytes(32, "little"),
+        )
+    )
+
+
 def check_prehashed(minus_A, R, k: int, s: int) -> bool:
     """Native ZIP215 cofactored equation check
     [8](R - ([s]B - [k]A)) == identity, taking the key's cached −A directly

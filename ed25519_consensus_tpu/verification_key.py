@@ -81,11 +81,14 @@ class VerificationKey:
     ZIP215 criteria for the encoded key `A_bytes`: it MUST decompress to a
     point on the curve, and non-canonical encodings MUST be accepted."""
 
-    __slots__ = ("A_bytes", "minus_A")
+    __slots__ = ("A_bytes", "minus_A", "_mA_row")
 
     def __init__(self, A_bytes: VerificationKeyBytes, minus_A: edwards.Point):
         self.A_bytes = A_bytes
         self.minus_A = minus_A
+        # lazily-cached 128-byte raw row of −A for the row-based native
+        # verify path (deterministic from minus_A, never stale)
+        self._mA_row = None
 
     @classmethod
     def from_bytes(cls, data) -> "VerificationKey":
@@ -176,10 +179,17 @@ class VerificationKey:
         s = scalar.from_canonical_bytes(signature.s_bytes)
         if s is None:
             raise InvalidSignature()
-        R = native.decompress_batch([signature.R_bytes])[0]
-        if R is None:
-            raise InvalidSignature()
-        # [8](R - ([s]B - [k]A)) == identity; native fast path with exact
-        # Python fallback — both compute the identical group equation.
-        if not native.check_prehashed(self.minus_A, R, k, s):
+        # Row-based native fast path: cached −A row + R decompressed
+        # straight into the check, no Python Point round-trips.  The
+        # exact-Python fallback computes the identical group equation.
+        row = self._mA_row
+        if row is None:
+            row = self._mA_row = native.point_row128(self.minus_A)
+        ok = native.check_prehashed_rows(row, signature.R_bytes, k, s)
+        if ok is NotImplemented:
+            R = native.decompress_batch([signature.R_bytes])[0]
+            if R is None:
+                raise InvalidSignature()
+            ok = native.check_prehashed(self.minus_A, R, k, s)
+        if not ok:
             raise InvalidSignature()
