@@ -1039,26 +1039,44 @@ class _DeviceLane:
 
     @classmethod
     def reset_all(cls, timeout: float = 5.0) -> bool:
-        """Shut down every lane worker (tests, driver dry runs).  A lane
-        is dropped from the registry only once its thread actually
-        exited, so the atexit drain can retry a worker that was still
-        mid-call; returns True when no worker remains alive."""
+        """Shut down every lane worker (tests, driver dry runs).
+        `timeout` is a TOTAL deadline across all lanes, not per-join —
+        several stuck lanes must not stack waits.  A lane whose worker
+        refuses to die within its slice is ABANDONED (deregistered and
+        moved to the retry side-registry): its queue now holds a poison
+        sentinel, so handing it to the next `get()` would give that
+        caller a worker that exits instead of serving submissions.
+        Returns True when no worker remains alive."""
+        import time as _time
+
+        end = _time.monotonic() + timeout
         with cls._instance_lock:
             lanes = list(cls._instances.items())
             abandoned = list(cls._abandoned_instances)
         all_dead = True
         for mode, inst in lanes:
             if inst._thread.is_alive():
-                inst.shutdown(timeout=timeout)
-            if inst._thread.is_alive():
-                all_dead = False
-                continue
+                inst.shutdown(
+                    timeout=max(0.0, end - _time.monotonic()))
             with cls._instance_lock:
-                if cls._instances.get(mode) is inst:
+                if inst._thread.is_alive():
+                    all_dead = False
+                    # poisoned queue ⇒ never reusable: deregister and
+                    # park for the next drain's retry (inline abandon();
+                    # calling abandon() here would re-take the held
+                    # non-reentrant _instance_lock)
+                    inst._abandoned = True
+                    _device_lane_stuck[0] = True
+                    if cls._instances.get(mode) is inst:
+                        del cls._instances[mode]
+                    if inst not in cls._abandoned_instances:
+                        cls._abandoned_instances.append(inst)
+                elif cls._instances.get(mode) is inst:
                     del cls._instances[mode]
         for inst in abandoned:
             if inst._thread.is_alive():
-                inst.shutdown(timeout=timeout)
+                inst.shutdown(
+                    timeout=max(0.0, end - _time.monotonic()))
             if inst._thread.is_alive():
                 all_dead = False
                 continue
@@ -1209,7 +1227,12 @@ class _DeviceLane:
 
 
 def _shutdown_device_lane():
-    _DeviceLane.reset_all()
+    # 30 s, not the 5 s default: a worker mid-compile for a discarded
+    # probe chunk finishes and joins given time, and a live worker at
+    # interpreter finalization nondeterministically aborts the process.
+    # Bounded regardless — a worker stuck in a seized tunnel never
+    # returns, and hanging every process exit on it would be worse.
+    _DeviceLane.reset_all(timeout=30.0)
 
 
 import atexit  # noqa: E402  (registration belongs next to the lane)

@@ -32,7 +32,21 @@ def _shutdown_device_lane_at_session_end():
     yield
     from ed25519_consensus_tpu import batch
 
-    batch._DeviceLane.reset_all()
+    # GENEROUS drain timeout: a lane worker can legitimately be parked
+    # inside a multi-minute XLA mesh-shape compile for a chunk whose
+    # caller already discarded it (the scheduler's async probe design).
+    # A worker still alive at interpreter finalization is the prime
+    # suspect for the nondeterministic teardown SEGV/heap-abort — the
+    # 5 s default drain quietly gave up exactly when the machine was
+    # contended enough for compiles to still be running.
+    drained = batch._DeviceLane.reset_all(timeout=300.0)
+    if not drained:
+        import sys
+
+        print("WARNING: device-lane worker still alive after 300s "
+              "drain; skipping cache teardown (finalization may abort)",
+              file=sys.stderr)
+        return
 
     # Release compiled-executable state Python-side, in a controlled
     # order, while the runtime is fully alive — instead of leaving ~100
