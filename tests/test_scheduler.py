@@ -193,8 +193,11 @@ def test_unwarmed_first_call_gets_compile_grace(monkeypatch):
     assert batch._device_cooldown_until[0] <= t0
     # …and the grace window doesn't park the caller behind the slow
     # call: the host lane covers the pool meanwhile (grace-hybrid), so
-    # total wall stays ~one slow call, not batches × slow calls
-    assert time.monotonic() - t0 < 10.0
+    # total wall stays ~one slow call, not batches × slow calls.  The
+    # bound is loose on purpose — the pathology it guards against is
+    # every chunk parking for the (minutes-long) grace window, and a
+    # tight bound flakes when another suite shares this 1-core node.
+    assert time.monotonic() - t0 < 20.0
 
 
 def test_cooldown_skips_device_entirely(monkeypatch):
