@@ -639,3 +639,53 @@ def test_discarded_queued_chunk_is_never_dispatched(monkeypatch):
     time.sleep(0.3)  # give the worker time to (incorrectly) run the 2nd
     assert calls == [1], calls  # exactly one dispatch: the first chunk
     assert not lane._results or queued not in lane._results
+
+
+def test_reset_all_abandons_worker_that_outlives_deadline(monkeypatch):
+    """reset_all semantics after the round-4 teardown fix: a worker that
+    outlives the TOTAL drain deadline (e.g. mid-XLA-compile for a chunk
+    its caller discarded) must be ABANDONED — deregistered, marked
+    stuck, parked in the retry side-registry — because its queue now
+    holds a poison sentinel: handing it to the next get() would give
+    that caller a worker that exits instead of serving.  Once the
+    worker finally finishes, the next drain reaps it."""
+    import numpy as np
+
+    release = threading.Event()
+
+    def blocked(digits, pts):
+        release.wait(timeout=30.0)
+        return np.zeros((digits.shape[0], 4, 20, digits.shape[1]),
+                        dtype=np.int32)
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", blocked)
+    lane = batch._DeviceLane.get()
+    d = np.zeros((1, 33, 8), dtype=np.int8)
+    p = np.zeros((1, 4, 20, 8), dtype=np.int16)
+    cid = lane.submit(d, p)
+    deadline = time.monotonic() + 5.0
+    while lane.started_at(cid) is None and time.monotonic() < deadline:
+        time.sleep(0.01)  # wait until the worker is INSIDE the call
+    assert lane.started_at(cid) is not None, \
+        "worker never entered the call; the scenario was not set up"
+    lane.discard(cid)  # caller walks away (the async probe pattern)
+    try:
+        # Total deadline, not per-lane: must return promptly and False.
+        t0 = time.monotonic()
+        assert not batch._DeviceLane.reset_all(timeout=0.3)
+        assert time.monotonic() - t0 < 5.0
+        # The stuck worker is deregistered and never reused…
+        assert batch._DeviceLane._instances.get(0) is not lane
+        assert lane._abandoned and not lane.healthy()
+        assert lane in batch._DeviceLane._abandoned_instances
+        assert batch.device_lane_stuck()
+        # …and a fresh get() hands out a NEW, working lane.
+        fresh = batch._DeviceLane.get()
+        assert fresh is not lane and fresh.healthy()
+    finally:
+        release.set()
+    # Worker finishes its call, pops the poison sentinel, exits; the
+    # next drain reaps the abandoned lane from the side registry.
+    assert batch._DeviceLane.reset_all(timeout=10.0)
+    assert lane not in batch._DeviceLane._abandoned_instances
+    assert not lane._thread.is_alive()
