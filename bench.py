@@ -351,6 +351,34 @@ def main():
             print(f"# [host pre-jax] run: {dt:.3f}s/batch -> "
                   f"{n/dt:.0f} sigs/s", file=sys.stderr)
 
+    def measure_secondary(config):
+        """Isolated small-batch secondary metric (VERDICT r3 #3): the
+        reference's own bench shape, measured on the pure-host path
+        every round (bench.rs:26-70 analog)."""
+        sb = build_batch(config, random.Random(0x5EC0))
+        rebuild_fresh(sb).verify(rng=rng, backend="host")  # warm caches
+        best_dt = float("inf")
+        for _ in range(max(5, args.runs)):
+            t0 = time.perf_counter()
+            rebuild_fresh(sb).verify(rng=rng, backend="host")
+            best_dt = min(best_dt, time.perf_counter() - t0)
+        val = sb.batch_size / best_dt
+        print(f"# [secondary {config}] {best_dt*1e3:.2f} ms/batch -> "
+              f"{val:.0f} sigs/s (pre-jax)", file=sys.stderr)
+        return round(val, 1)
+
+    # Secondary host-path metrics every round (VERDICT r3 #3 + the
+    # structural adversarial mix, r3 #2): measured HERE, before anything
+    # imports jax — the accelerator runtime's background threads tax the
+    # lone host core 25-40%, and these are host-path numbers.
+    secondary = {}
+    for cfg in ("bench32", "cometbft128", "adversarial"):
+        if cfg != args.config:
+            try:
+                secondary[cfg] = measure_secondary(cfg)
+            except Exception as e:  # noqa: BLE001
+                secondary[cfg] = f"error: {type(e).__name__}"
+
     # Warmup (compiles the kernel for this batch's padded lane count).
     # The remote-compile tunnel is occasionally flaky OR arbitrarily slow:
     # retry errors once, cap wall time with a watchdog thread, then fall
@@ -543,22 +571,6 @@ def main():
             "seconds": round(dt, 3),
         }
 
-    def measure_secondary(config):
-        """Isolated small-batch secondary metric (VERDICT r3 #3): the
-        reference's own bench shape, measured on the pure-host path
-        every round (bench.rs:26-70 analog)."""
-        sb = build_batch(config, random.Random(0x5EC0))
-        rebuild_fresh(sb).verify(rng=rng, backend="host")  # warm caches
-        best_dt = float("inf")
-        for _ in range(max(5, args.runs)):
-            t0 = time.perf_counter()
-            rebuild_fresh(sb).verify(rng=rng, backend="host")
-            best_dt = min(best_dt, time.perf_counter() - t0)
-        val = sb.batch_size / best_dt
-        print(f"# [secondary {config}] {best_dt*1e3:.2f} ms/batch -> "
-              f"{val:.0f} sigs/s", file=sys.stderr)
-        return round(val, 1)
-
     best = measure(backend, depth)
     stats = {}
     try:
@@ -587,16 +599,6 @@ def main():
         # health); report whichever configuration a user would deploy.
         best = host_best
         backend = "host"
-
-    # Secondary host-path metrics every round: the isolated small-batch
-    # configs (VERDICT r3 #3) + the structural adversarial mix (r3 #2).
-    secondary = {}
-    for cfg in ("bench32", "cometbft128", "adversarial"):
-        if cfg != args.config:
-            try:
-                secondary[cfg] = measure_secondary(cfg)
-            except Exception as e:  # noqa: BLE001
-                secondary[cfg] = f"error: {type(e).__name__}"
 
     value = n / best
     print(json.dumps({
