@@ -21,7 +21,14 @@ from .signature import Signature
 from .signing_key import SigningKey
 from .verification_key import VerificationKey, VerificationKeyBytes
 
-__version__ = "0.1.0"
+# Single source of truth is pyproject.toml; the literal below is only the
+# fallback for uninstalled sys.path-insertion use (tools/, subprocess tests)
+try:
+    from importlib.metadata import PackageNotFoundError, version as _pkg_version
+
+    __version__ = _pkg_version("ed25519-consensus-tpu")
+except PackageNotFoundError:  # pragma: no cover - uninstalled checkout
+    __version__ = "0.5.0"
 
 __all__ = [
     "Error",

@@ -1041,7 +1041,10 @@ class _DeviceLane:
     def reset_all(cls, timeout: float = 5.0) -> bool:
         """Shut down every lane worker (tests, driver dry runs).
         `timeout` is a TOTAL deadline across all lanes, not per-join —
-        several stuck lanes must not stack waits.  A lane whose worker
+        several stuck lanes must not stack waits beyond a 50 ms/lane
+        join floor (so a healthy idle worker is not abandoned just
+        because an earlier lane ate the budget; worst case the deadline
+        overshoots by 0.05*n_lanes).  A lane whose worker
         refuses to die within its slice is ABANDONED (deregistered and
         moved to the retry side-registry): its queue now holds a poison
         sentinel, so handing it to the next `get()` would give that
@@ -1056,8 +1059,11 @@ class _DeviceLane:
         all_dead = True
         for mode, inst in lanes:
             if inst._thread.is_alive():
+                # floor of 50 ms even when an earlier lane ate the budget:
+                # a healthy idle worker joins in microseconds and should
+                # not be abandoned just because a sibling was stuck
                 inst.shutdown(
-                    timeout=max(0.0, end - _time.monotonic()))
+                    timeout=max(0.05, end - _time.monotonic()))
             with cls._instance_lock:
                 if inst._thread.is_alive():
                     all_dead = False
@@ -1076,7 +1082,7 @@ class _DeviceLane:
         for inst in abandoned:
             if inst._thread.is_alive():
                 inst.shutdown(
-                    timeout=max(0.0, end - _time.monotonic()))
+                    timeout=max(0.05, end - _time.monotonic()))
             if inst._thread.is_alive():
                 all_dead = False
                 continue
@@ -1822,7 +1828,7 @@ def verify_single_many(entries, rng=None) -> "list[bool]":
     # queue_bulk grouped by key in entry order, so per-key iterators hand
     # each entry its own (k, sig) back in order.
     by_key = {vkb: iter(ksigs)
-              for vkb, ksigs in staging.signatures.items()}
+              for vkb, ksigs in staging._materialized().items()}
     verifiers = []
     poison = [(0, Signature(b"\xff" * 32, b"\xff" * 32))]
     for e in cleaned:

@@ -603,9 +603,12 @@ def check_prehashed_rows(mA_row: bytes, R_enc, k: int, s: int):
     lib = load()
     if lib is None:
         return NotImplemented
+    R_enc = bytes(R_enc)
+    if len(R_enc) != 32:
+        return False
     out = ctypes.create_string_buffer(128)
     okb = ctypes.create_string_buffer(1)
-    lib.zip215_decompress_batch(bytes(R_enc), 1, out, okb, None)
+    lib.zip215_decompress_batch(R_enc, 1, out, okb, None)
     if okb.raw[0] == 0:
         return False
     return bool(

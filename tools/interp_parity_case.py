@@ -22,7 +22,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from ed25519_consensus_tpu.ops import edwards, msm, pallas_msm  # noqa: E402
+from ed25519_consensus_tpu.ops import edwards, limbs, msm, pallas_msm  # noqa: E402
 
 
 def main():
@@ -61,11 +61,17 @@ def main():
         sc_wide, pts, n_lanes=pallas_msm.pad_lanes(n, group)
     )
     want_wide = edwards.multiscalar_mul(sc_wide, pts)
+    # nibble-packed digit wire through the SAME Pallas pipeline: pins the
+    # dwire='packed' branch of _compiled_pipeline (in-jit expand_digits
+    # feeding the kernel), which the forced-cpu suite's XLA-path parity
+    # test never reaches
+    dig_w_packed = limbs.pack_digit_planes(dig_w)
     verdicts = []
     for body in bodies:
         for dig, pk, want_pt, label in (
             (digits, packed, want, "small"),
             (dig_w, packed_w, want_wide, "wide"),
+            (dig_w_packed, packed_w, want_wide, "wide-packed-dwire"),
         ):
             out = np.asarray(
                 pallas_msm.pallas_window_sums_many(
