@@ -1,0 +1,162 @@
+"""Generate the independent legacy-oracle verdict corpus.
+
+The reference pins its pre-ZIP215 "legacy" rules with a separately-authored
+crate (reference Cargo.toml:27, tests/util/mod.rs:51-56: ed25519-zebra v1,
+libsodium-1.0.15-compatible).  Our `utils/legacy.py` re-implements those
+rules from the same analytic model the conformance test checks against —
+so until round 5 the legacy half of test_conformance was self-referential.
+
+This tool breaks the loop with OpenSSL (via the `cryptography` wheel): a
+genuinely independent Ed25519 implementation (ref10-derived C, separate
+authorship, separate field/point/scalar arithmetic).  OpenSSL's verify is
+cofactorless and recomputes R — the same core as the legacy rules — and
+differs from libsodium 1.0.15 by exactly two documented, data-pinned
+deltas:
+
+  * OpenSSL does NOT implement libsodium's 11-entry small-order R
+    blacklist (utils/fixtures.py EXCLUDED_POINT_ENCODINGS);
+  * OpenSSL does NOT special-case the all-zero verification key.
+
+So for every case:  legacy == openssl AND not blacklisted_R AND not
+zero_key.  The committed corpus stores the raw OpenSSL verdicts; the test
+(tests/test_legacy_corpus.py) asserts `legacy_verify` against them through
+that formula.  A bug shared by `utils/legacy.py` and the analytic model in
+tests/test_small_order.py now fails loudly against OpenSSL's verdicts.
+
+Corpus sections:
+  * the full 196-case small-order matrix (14x14 encodings, s=0, msg
+    b"Zcash" — reference tests/small_order.rs:12-77);
+  * the 3 RFC 8032 section 7.1 vectors (valid) plus tampered-message,
+    tampered-R, and wrong-key mutations of each;
+  * deterministic random cases: valid signatures, s+ell malleated
+    (both must reject), non-canonical-R re-encodings, bitflipped s.
+
+Regenerate with `python tools/gen_legacy_corpus.py` (writes
+tests/data/legacy_oracle_corpus.json); verdicts are snapshotted with the
+generating OpenSSL version so drift in a future OpenSSL is visible.
+"""
+
+import hashlib
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu import SigningKey  # noqa: E402
+from ed25519_consensus_tpu.ops import edwards, scalar  # noqa: E402
+from ed25519_consensus_tpu.utils import fixtures  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data", "legacy_oracle_corpus.json")
+
+
+def openssl_verify(vk: bytes, sig: bytes, msg: bytes) -> bool:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    try:
+        Ed25519PublicKey.from_public_bytes(vk).verify(sig, msg)
+        return True
+    except Exception:
+        return False
+
+
+def matrix_cases():
+    """The 196 (A, R) small-order pairs with s=0 over msg b"Zcash"."""
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    assert len(encs) == 14
+    s0 = b"\x00" * 32
+    for A in encs:
+        for R in encs:
+            yield "matrix", A, R + s0, b"Zcash"
+
+
+def rfc8032_cases():
+    vectors = [
+        ("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+         "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+         "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e065224901555f"
+         "b8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+         ""),
+        ("4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+         "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+         "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da08"
+         "5ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+         "72"),
+        ("c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+         "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+         "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac18"
+         "ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+         "af82"),
+    ]
+    for _sk, pk, sig, msg in vectors:
+        vk, sb, m = bytes.fromhex(pk), bytes.fromhex(sig), bytes.fromhex(msg)
+        yield "rfc8032-valid", vk, sb, m
+        yield "rfc8032-tampered-msg", vk, sb, m + b"x"
+        flipped_R = bytes([sb[0] ^ 1]) + sb[1:]
+        yield "rfc8032-tampered-R", vk, flipped_R, m
+        wrong_vk = bytes.fromhex(vectors[0][1]) if pk != vectors[0][1] \
+            else bytes.fromhex(vectors[1][1])
+        yield "rfc8032-wrong-key", wrong_vk, sb, m
+
+
+def random_cases():
+    rng = random.Random(0x5E6AC7)
+    for i in range(24):
+        sk = SigningKey.new(rng)
+        msg = b"legacy corpus %d" % i
+        sig = bytes(sk.sign(msg))
+        vk = sk.verification_key_bytes().to_bytes()
+        yield "random-valid", vk, sig, msg
+        R_b, s_b = sig[:32], sig[32:]
+        s = int.from_bytes(s_b, "little")
+        if i % 3 == 0:
+            # s + ell still fits 256 bits: a canonical-s check must reject
+            mall = R_b + (s + scalar.L).to_bytes(32, "little")
+            yield "random-malleated-s", vk, mall, msg
+        if i % 3 == 1:
+            # swap R for a non-canonical low-order encoding under an
+            # otherwise-valid key/message: equation breaks, and the
+            # encodings exercise each oracle's decompress acceptance
+            nc = fixtures.non_canonical_point_encodings()
+            yield ("random-noncanonical-R", vk,
+                   nc[i % len(nc)] + s_b, msg)
+        if i % 3 == 2:
+            yield ("random-bitflip-s", vk,
+                   R_b + bytes([s_b[0] ^ 1]) + s_b[1:], msg)
+
+
+def main():
+    import cryptography
+
+    cases = []
+    for gen in (matrix_cases, rfc8032_cases, random_cases):
+        for kind, vk, sig, msg in gen():
+            cases.append({
+                "kind": kind,
+                "vk": vk.hex(),
+                "sig": sig.hex(),
+                "msg": msg.hex(),
+                "openssl": openssl_verify(vk, sig, msg),
+            })
+    corpus = {
+        "comment": "Independent legacy-oracle verdicts; see "
+                   "tools/gen_legacy_corpus.py and "
+                   "tests/test_legacy_corpus.py",
+        "oracle": "OpenSSL via cryptography %s" % cryptography.__version__,
+        "cases": cases,
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(corpus, f, indent=1)
+        f.write("\n")
+    n_true = sum(c["openssl"] for c in cases)
+    print(f"wrote {len(cases)} cases ({n_true} accept) to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
