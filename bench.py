@@ -354,18 +354,31 @@ def main():
     def measure_secondary(config):
         """Isolated small-batch secondary metric (VERDICT r3 #3): the
         reference's own bench shape, measured on the pure-host path
-        every round (bench.rs:26-70 analog)."""
+        every round (bench.rs:26-70 analog).  Criterion-grade capture
+        (VERDICT r4 #2/#8): a ~2.5 s time-budgeted loop — thousands of
+        iterations, not best-of-5, so the figure is the path's actual
+        floor in this window, with median+spread carried alongside
+        (±25% co-tenant noise on this node makes a 5-sample best a
+        lottery)."""
         sb = build_batch(config, random.Random(0x5EC0))
-        rebuild_fresh(sb).verify(rng=rng, backend="host")  # warm caches
-        best_dt = float("inf")
-        for _ in range(max(5, args.runs)):
+        for _ in range(4):  # warm caches (split/prebuilt land at 3rd)
+            rebuild_fresh(sb).verify(rng=rng, backend="host")
+        ts = []
+        budget_end = time.perf_counter() + 2.5
+        while time.perf_counter() < budget_end and len(ts) < 20_000:
             t0 = time.perf_counter()
             rebuild_fresh(sb).verify(rng=rng, backend="host")
-            best_dt = min(best_dt, time.perf_counter() - t0)
-        val = sb.batch_size / best_dt
-        print(f"# [secondary {config}] {best_dt*1e3:.2f} ms/batch -> "
-              f"{val:.0f} sigs/s (pre-jax)", file=sys.stderr)
-        return round(val, 1)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        n = sb.batch_size
+        best, med = ts[0], ts[len(ts) // 2]
+        p90 = ts[int(len(ts) * 0.9)]
+        print(f"# [secondary {config}] best {best*1e6:.0f}us "
+              f"med {med*1e6:.0f}us p90 {p90*1e6:.0f}us over "
+              f"{len(ts)} iters -> best {n/best:.0f} "
+              f"med {n/med:.0f} sigs/s (pre-jax)", file=sys.stderr)
+        return {"best": round(n / best, 1), "median": round(n / med, 1),
+                "p90": round(n / p90, 1), "iters": len(ts)}
 
     # Secondary host-path metrics every round (VERDICT r3 #3 + the
     # structural adversarial mix, r3 #2): measured HERE, before anything

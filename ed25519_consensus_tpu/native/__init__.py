@@ -127,6 +127,13 @@ def load():
         lib.msm_prof.restype = None
         lib.msm_prof_reset.argtypes = []
         lib.msm_prof_reset.restype = None
+        lib.zip215_verify_sig_k.argtypes = [ctypes.c_char_p] * 5
+        lib.zip215_verify_sig_k.restype = ctypes.c_int
+        lib.zip215_verify_sig.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.zip215_verify_sig.restype = ctypes.c_int
         _self_check(lib)
         _lib = lib
     except Exception:
@@ -591,6 +598,43 @@ def point_row128(pt) -> bytes:
     """Public alias for the canonical 128-byte X‖Y‖Z‖T row serializer
     (callers cache rows of long-lived points, e.g. a key's −A)."""
     return _point128(pt)
+
+
+def verify_sig_k(vk_bytes: bytes, R_bytes: bytes, s_bytes: bytes,
+                 k: int):
+    """Fully-fused single verification with a precomputed challenge
+    (the batch `Item` path): s < ℓ, ZIP215 R decompression, split
+    double-base Horner over the per-key native table cache, cofactored
+    identity check — one FFI crossing (reference
+    src/verification_key.rs:238-258).  Returns 1 valid / 0 invalid
+    signature / -1 malformed key; NotImplemented without the library."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    if len(vk_bytes) != 32 or len(R_bytes) != 32 or len(s_bytes) != 32:
+        return 0 if len(vk_bytes) == 32 else -1
+    return lib.zip215_verify_sig_k(
+        vk_bytes, R_bytes, s_bytes, int(k).to_bytes(32, "little"),
+        basepoint_row128())
+
+
+def verify_sig(vk_bytes: bytes, sig_bytes: bytes, msg: bytes):
+    """Fully-fused single verification from wire bytes, challenge hash
+    included (native scalar SHA-512) — the whole reference
+    verification_key.rs:225-258 in one FFI crossing.  Same return
+    convention as `verify_sig_k`."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    if len(vk_bytes) != 32:
+        return -1
+    if len(sig_bytes) != 64:
+        return 0
+    if not isinstance(msg, bytes):  # bytearray/memoryview callers
+        msg = bytes(msg)
+    return lib.zip215_verify_sig(
+        bytes(vk_bytes), bytes(sig_bytes), msg, len(msg),
+        basepoint_row128())
 
 
 def check_prehashed_rows(mA_row: bytes, R_enc, k: int, s: int):

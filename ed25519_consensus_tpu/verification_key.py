@@ -159,6 +159,20 @@ class VerificationKey:
         """ZIP215 verification (reference src/verification_key.rs:225-233):
         k = H(R ‖ A ‖ msg) wide-reduced mod ℓ, then the prehashed check.
         Raises InvalidSignature on failure; returns None on success."""
+        from . import native
+
+        if len(msg) <= 4096:
+            # One FFI crossing for the whole check, challenge hash
+            # included.  Large messages stay on hashlib (OpenSSL's
+            # assembly SHA-512 outruns the native scalar compression
+            # there) + the prehashed path.
+            ok = native.verify_sig(
+                self.A_bytes.to_bytes(),
+                signature.R_bytes + signature.s_bytes, msg)
+            if ok is not NotImplemented:
+                if ok != 1:  # -1 unreachable: self was validated at parse
+                    raise InvalidSignature()
+                return
         h = hashlib.sha512()
         h.update(signature.R_bytes)
         h.update(self.A_bytes.to_bytes())

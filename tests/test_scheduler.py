@@ -194,10 +194,12 @@ def test_unwarmed_first_call_gets_compile_grace(monkeypatch):
     # …and the grace window doesn't park the caller behind the slow
     # call: the host lane covers the pool meanwhile (grace-hybrid), so
     # total wall stays ~one slow call, not batches × slow calls.  The
-    # bound is loose on purpose — the pathology it guards against is
-    # every chunk parking for the (minutes-long) grace window, and a
-    # tight bound flakes when another suite shares this 1-core node.
-    assert time.monotonic() - t0 < 20.0
+    # pathology this guards against is each chunk parking for the 600 s
+    # unwarmed-shape grace budget (batch.py poll()), so the bound only
+    # needs to sit far below ONE grace window while tolerating heavy
+    # co-tenant load on this 1-core node (a second full suite slowed the
+    # clean-core ~6 s wall past the old 20 s bound — round-4 flake).
+    assert time.monotonic() - t0 < 90.0
 
 
 def test_cooldown_skips_device_entirely(monkeypatch):
