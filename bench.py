@@ -340,6 +340,7 @@ def main():
     # accelerator runtime's background threads visibly slow the (single)
     # host core, so the host path is fastest in a jax-free process state.
     host_best = None
+    host_prejax_times = []
     if args.backend == "device":
         rebuild_fresh(bv).verify(rng=rng, backend="host")  # warm native lib
         host_best = float("inf")
@@ -347,6 +348,7 @@ def main():
             t0 = time.time()
             rebuild_fresh(bv).verify(rng=rng, backend="host")
             dt = time.time() - t0
+            host_prejax_times.append(dt)
             host_best = min(host_best, dt)
             print(f"# [host pre-jax] run: {dt:.3f}s/batch -> "
                   f"{n/dt:.0f} sigs/s", file=sys.stderr)
@@ -527,6 +529,8 @@ def main():
               f"(measured={s.get('device_measured')})", file=sys.stderr)
         batch_mod.reset_device_health()
 
+    run_times = []  # per-batch seconds, every measured run (spread in JSON)
+
     def measure(run_backend, run_depth):
         best = float("inf")
         for _ in range(args.runs):
@@ -548,6 +552,7 @@ def main():
             else:
                 rebuild_fresh(bv).verify(rng=rng, backend=run_backend)
             dt = (time.time() - t0) / run_depth
+            run_times.append(dt)
             best = min(best, dt)
             print(f"# [{run_backend}] run: {dt:.3f}s/batch -> "
                   f"{n/dt:.0f} sigs/s", file=sys.stderr)
@@ -584,6 +589,90 @@ def main():
             "seconds": round(dt, 3),
         }
 
+    def measure_device_program(calls: int = 2, chunk_b: int = 8):
+        """On-chip program time of the production dispatch via the jax
+        profiler: trace `calls` warmed dispatches (default wires, B=8),
+        then sum the device-track `XLA Modules` event durations — the
+        chip's own execution time, excluding tunnel RTT, H2D/D2H
+        transfer, and host glue.  Returns terms/s and the
+        sigs-equivalent/s rate (this config's sigs per program-second)."""
+        import glob as _glob
+        import gzip as _gzip
+        import tempfile
+
+        import jax
+        import numpy as _np
+
+        from ed25519_consensus_tpu.ops import msm as _msm
+
+        staged = rebuild_fresh(bv)._stage(rng)
+        pad = _msm.preferred_pad(staged.n_device_terms)
+        d, p = staged.device_operands(lambda _n: pad)
+        dd = _np.stack([d] * chunk_b)
+        pp = _np.stack([p] * chunk_b)
+        tmp = tempfile.mkdtemp(prefix="ed25519_trace_")
+        wall_box = [None]
+
+        def traced_calls():
+            _np.asarray(_msm.dispatch_window_sums_many(dd, pp))  # warm
+            t0 = time.time()
+            with jax.profiler.trace(tmp):
+                for _ in range(calls):
+                    _np.asarray(_msm.dispatch_window_sums_many(dd, pp))
+            wall_box[0] = time.time() - t0
+
+        # Watchdog, same rationale as the warmup: a seized tunnel or an
+        # abandoned warm thread holding the device-call lock would park
+        # this main-thread dispatch forever — the bench must always
+        # print its JSON line.
+        res = _timed(traced_calls, 180)  # None = success
+        if res is not None:
+            return {"error": f"watchdog: {res}"[:120]}
+        wall = wall_box[0]
+        import shutil
+
+        paths = sorted(_glob.glob(
+            os.path.join(tmp, "**", "*.trace.json.gz"), recursive=True))
+        if not paths:
+            shutil.rmtree(tmp, ignore_errors=True)
+            return {"error": "no trace produced"}
+        with _gzip.open(paths[-1], "rt") as f:
+            events = json.load(f).get("traceEvents", [])
+        shutil.rmtree(tmp, ignore_errors=True)
+        dev_pids = {e["pid"] for e in events
+                    if e.get("ph") == "M" and e.get("name") == "process_name"
+                    and "/device:" in e["args"].get("name", "")}
+        mod_tids = {(e["pid"], e.get("tid")) for e in events
+                    if e.get("ph") == "M" and e.get("name") == "thread_name"
+                    and e["pid"] in dev_pids
+                    and e["args"].get("name") == "XLA Modules"}
+        total_us = sum(e.get("dur", 0) for e in events
+                       if e.get("ph") == "X"
+                       and (e["pid"], e.get("tid")) in mod_tids)
+        n_mods = sum(1 for e in events
+                     if e.get("ph") == "X"
+                     and (e["pid"], e.get("tid")) in mod_tids)
+        if total_us <= 0:
+            return {"error": "no device module events in trace"}
+        program_s = total_us / 1e6
+        real_terms = staged.n_device_terms * chunk_b * calls
+        padded_terms = pad * chunk_b * calls
+        res = {
+            "program_ms_per_call": round(total_us / 1e3 / calls, 1),
+            "terms_per_sec": round(real_terms / program_s, 1),
+            "padded_terms_per_sec": round(padded_terms / program_s, 1),
+            "sigs_equiv_per_sec": round(n * chunk_b * calls / program_s, 1),
+            "calls": calls,
+            "modules": n_mods,
+            "wall_seconds": round(wall, 3),
+            "shape": [chunk_b, int(pad)],
+        }
+        print(f"# [device-program] {res['program_ms_per_call']} ms/call "
+              f"on-chip -> {res['terms_per_sec']:.0f} terms/s, "
+              f"{res['sigs_equiv_per_sec']:.0f} sigs-equiv/s "
+              f"(wall {wall:.2f}s for {calls} calls)", file=sys.stderr)
+        return res
+
     best = measure(backend, depth)
     stats = {}
     try:
@@ -598,14 +687,25 @@ def main():
     # race — BENCH JSON must carry an auditable TPU-path number every
     # round.
     device_only = None
+    device_program = None
     if backend == "device" and depth > 1:
         try:
-            # 16 batches ≈ two full chunks after the padded probe — the
+            # 16 batches = two full pipelined chunks (forced-device mode
+            # runs full chunks from the first call — round 5) — the
             # steady-state per-chunk economics, not a half-empty-chunk
             # penalty.
             device_only = measure_device_only(min(16, depth))
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             device_only = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+        try:
+            # At-HEAD ON-CHIP program time (VERDICT r4 #1): jax-profiler
+            # trace of the production dispatch (default wires, B=8 at
+            # this config's padded lane count), device `XLA Modules`
+            # execution time only — what the chip itself sustains, with
+            # the tunnel/transfer/host costs stripped.
+            device_program = measure_device_program()
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            device_program = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
 
     if host_best is not None and host_best < best:
         # The right lane split depends on the node (host core count, link
@@ -614,11 +714,24 @@ def main():
         backend = "host"
 
     value = n / best
+    # spread over the runs of whichever lane the headline reports
+    # (VERDICT r4 missing #2: median + spread, not only best-of-N).
+    # `backend` was reassigned to "host" above iff the pre-jax host runs
+    # won the headline.
+    spread_times = (host_prejax_times
+                    if host_prejax_times and backend == "host"
+                    else run_times)
+    rt = sorted(spread_times)
+    spread = {
+        "runs_sigs_per_sec": [round(n / t, 1) for t in spread_times],
+        "median_sigs_per_sec": round(n / rt[len(rt) // 2], 1) if rt else None,
+    }
     print(json.dumps({
         "metric": f"batch_verify_sigs_per_sec[{args.config},{backend}]",
         "value": round(value, 1),
         "unit": "sigs/sec/chip",
         "vs_baseline": round(value / 200_000, 4),
+        "spread": spread,
         "hardware_parity": parity,
         "lane_split": {
             # merged (union) runs rename the keys to *_unions
@@ -630,6 +743,12 @@ def main():
             "device_sick": stats.get("device_sick"),
         },
         "device_only": device_only,
+        # scalar, as named; full detail (incl. sigs_equiv_per_sec and
+        # program_ms_per_call) in the sibling "device_program" dict
+        "device_program_terms_per_sec": (
+            device_program.get("terms_per_sec")
+            if isinstance(device_program, dict) else None),
+        "device_program": device_program,
         "secondary_host_sigs_per_sec": secondary,
     }))
 
