@@ -1,0 +1,123 @@
+"""Mesh-lane scaling characterization on the virtual CPU mesh
+(VERDICT r4 #5).
+
+The multi-chip dry run proves the sharded path compiles and executes;
+this lab measures its SCALING STRUCTURE — collective + shard-padding
+overhead vs term count — so the mesh path has a cost model before real
+multi-chip hardware exists.
+
+Every wall number here is a VIRTUAL-MESH (8 XLA host-platform devices
+on one CPU core) artifact: absolute throughput is meaningless for TPU,
+but the structure is real and transfers —
+
+* the per-call fixed cost a(D) (dispatch + all_gather of D partial
+  window-sum tensors + D-step Edwards fold, all compiled into the one
+  program) appears as the intercept of wall(N) per device count;
+* shard padding (shard_pad rounds N up to D * lane-group multiples)
+  appears as wasted lanes at small N — the inflation factor is exact
+  and hardware-independent;
+* the per-term slope b(D) should scale ~1/D on real parallel hardware;
+  on the virtual mesh all D shards timeshare one core, so slope(D) ~
+  slope(1) — measured and labeled as such.
+
+Usage (forces the cpu backend itself):
+
+    python tools/mesh_scaling_lab.py [--ns 2048,8192,32768]
+        [--devices 1,2,4,8] [--runs 3]
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ns", default="2048,8192,32768")
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--runs", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(f"# backend: {jax.devices()[0].platform} x {len(jax.devices())} "
+          f"(virtual mesh on one core — see header caveat)", flush=True)
+
+    from ed25519_consensus_tpu.ops import edwards, msm
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    rng = random.Random(0x715C)
+    base_pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, 2**200))
+                for _ in range(64)]
+
+    ns = [int(x) for x in args.ns.split(",")]
+    ds = [int(x) for x in args.devices.split(",")]
+    rows = []
+    for n in ns:
+        pts = [base_pts[i % 64] for i in range(n)]
+        sc = [rng.randrange(2**128) for _ in range(n)]
+        want = None
+        for d in ds:
+            pad = (msm.preferred_pad(n) if d == 1
+                   else sharded_msm.shard_pad(n, d))
+            digits, packed = msm.pack_msm_operands(sc, pts, n_lanes=pad)
+            t0 = time.perf_counter()
+            if d == 1:
+                out = np.asarray(msm.dispatch_window_sums(digits, packed))
+            else:
+                out = np.asarray(sharded_msm.sharded_window_sums(
+                    digits, packed, d))
+            t_first = time.perf_counter() - t0
+            walls = []
+            for _ in range(args.runs):
+                t0 = time.perf_counter()
+                if d == 1:
+                    out = np.asarray(
+                        msm.dispatch_window_sums(digits, packed))
+                else:
+                    out = np.asarray(sharded_msm.sharded_window_sums(
+                        digits, packed, d))
+                walls.append(time.perf_counter() - t0)
+            got = msm.combine_window_sums(
+                out if out.ndim == 3 else out[0])
+            if want is None:
+                want = edwards.multiscalar_mul(sc, pts)
+            ok = got == want
+            best = min(walls)
+            rows.append((n, d, pad, best))
+            print(f"# n={n:7d} D={d}  pad={pad:7d} "
+                  f"(x{pad/n:.3f} lanes)  first={t_first:6.1f}s  "
+                  f"best={best*1e3:8.1f}ms  med={sorted(walls)[len(walls)//2]*1e3:8.1f}ms  "
+                  f"{'parity-ok' if ok else 'PARITY-MISMATCH'}",
+                  flush=True)
+            if not ok:
+                raise SystemExit("mesh parity mismatch — investigate")
+
+    # Per-device-count linear model wall(N) = a + b*N from the (first,
+    # last) N points: a = fixed dispatch+collective+fold cost, b =
+    # per-term cost (timeshared on the virtual mesh).
+    print("# model wall(N) = a + b*N per D (from endpoint fit):",
+          flush=True)
+    for d in ds:
+        sub = [(n, w) for n, dd, _p, w in rows if dd == d]
+        if len(sub) >= 2:
+            (n0, w0), (n1, w1) = sub[0], sub[-1]
+            b = (w1 - w0) / (n1 - n0)
+            a = w0 - b * n0
+            print(f"#   D={d}: a={a*1e3:7.1f}ms  b={b*1e6:7.3f}us/term",
+                  flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
