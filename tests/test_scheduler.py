@@ -298,15 +298,26 @@ def test_unresolved_probe_streak_arms_backoff(monkeypatch):
 
 def test_measured_probe_resets_unresolved_streak(monkeypatch):
     """A probe that DOES resolve (measured EMA) must clear the unresolved
-    streak — only consecutive unresolved probes arm the backoff."""
+    streak — only consecutive unresolved probes arm the backoff.
+
+    The young-probe grace is raised for the assertion to hold under
+    co-tenant load: this test REQUIRES the probe to resolve, and on the
+    forced-cpu suite a second full suite on the same core can stretch
+    the warm virtual-kernel call past the production 3 s grace (the
+    round-5 tally's one contended failure)."""
     warm_kernel_cache()
-    batch._unresolved_probe_streak[0] = batch._UNRESOLVED_PROBE_LIMIT - 1
-    vs = make_verifiers(4)
-    assert batch.verify_many(vs, rng=rng, chunk=2,
-                             merge="never") == expected(4)
-    assert batch.last_run_stats["device_measured"] or \
-        batch.last_run_stats["device_batches"]
-    assert batch._unresolved_probe_streak[0] == 0
+    old_grace = batch._young_probe_grace[0]
+    batch._young_probe_grace[0] = 60.0
+    try:
+        batch._unresolved_probe_streak[0] = batch._UNRESOLVED_PROBE_LIMIT - 1
+        vs = make_verifiers(4)
+        assert batch.verify_many(vs, rng=rng, chunk=2,
+                                 merge="never") == expected(4)
+        assert batch.last_run_stats["device_measured"] or \
+            batch.last_run_stats["device_batches"]
+        assert batch._unresolved_probe_streak[0] == 0
+    finally:
+        batch._young_probe_grace[0] = old_grace
 
 
 def test_host_overtake_discards_inflight_chunk(monkeypatch):
