@@ -134,6 +134,8 @@ def load():
             ctypes.c_uint64, ctypes.c_char_p,
         ]
         lib.zip215_verify_sig.restype = ctypes.c_int
+        lib.zip215_vk_cache_drop.argtypes = []
+        lib.zip215_vk_cache_drop.restype = ctypes.c_uint64
         _self_check(lib)
         _lib = lib
     except Exception:
@@ -616,6 +618,18 @@ def verify_sig_k(vk_bytes: bytes, R_bytes: bytes, s_bytes: bytes,
     return lib.zip215_verify_sig_k(
         vk_bytes, R_bytes, s_bytes, int(k).to_bytes(32, "little"),
         basepoint_row128())
+
+
+def vk_cache_drop() -> "int | None":
+    """TEST HOOK: empty the native per-key table cache (entries are
+    parked immortally for pointer stability, not freed).  Lets a test
+    that deliberately fills the cache to its cap restore the cached
+    split-Horner path for the rest of the process.  Returns the number
+    of entries dropped; None without the library."""
+    lib = load()
+    if lib is None:
+        return None
+    return int(lib.zip215_vk_cache_drop())
 
 
 def verify_sig(vk_bytes: bytes, sig_bytes: bytes, msg: bytes):

@@ -19,6 +19,7 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
@@ -2265,6 +2266,22 @@ int zip215_verify_sig_k(const uint8_t *vk32, const uint8_t *R32,
                         const uint8_t *s32, const uint8_t *k32,
                         const uint8_t *b_row128) {
     return verify_one_core(vk32, R32, s32, k32, b_row128);
+}
+
+// Empty the per-key table cache WITHOUT freeing entries (tests that
+// deliberately fill it to the cap must not leave every later verify in
+// the process on the uncached fallback).  Entry pointers must stay
+// valid forever — a concurrent verifier may hold one past the lock —
+// so dropped entries move to an immortal graveyard rather than being
+// deleted (bounded by drops x cap; this is a test hook, not a
+// production size-management API).  Returns the number dropped.
+uint64_t zip215_vk_cache_drop(void) {
+    static std::vector<vk_tables *> graveyard;
+    std::lock_guard<std::mutex> lk(vk_cache_mu);
+    uint64_t n = vk_cache.size();
+    for (auto &kv : vk_cache) graveyard.push_back(kv.second);
+    vk_cache.clear();
+    return n;
 }
 
 // Full verification from wire bytes: k = SHA-512(R ‖ A ‖ msg) mod ℓ

@@ -266,3 +266,96 @@ def test_bulk_challenges_parity_across_padding_boundaries():
         assert ks[i] == want, (i, len(m))
         assert int.from_bytes(kblob[32 * i: 32 * i + 32],
                               "little") == want, (i, len(m))
+
+
+def test_fused_single_verify_parity_and_wire_shapes():
+    """Round-5 fused single verify (zip215_verify_sig/_sig_k):
+    conformance to the expected ZIP215 verdicts over the full
+    small-order matrix (all 196 pairs valid — the analytic model pinned
+    by tests/test_small_order.py) + random valid/invalid signatures,
+    byte-like message inputs (the FFI path must coerce
+    bytearray/memoryview), and the malformed-key / bad-s return
+    convention."""
+    import hashlib
+
+    from ed25519_consensus_tpu.ops import scalar
+
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+
+    # matrix parity: every (A, R) small-order pair, s=0 (196 cases)
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    s0 = b"\x00" * 32
+    for A in encs:
+        for R in encs:
+            got = native.verify_sig(A, R + s0, b"Zcash")
+            # ZIP215: all 196 pairs verify (tests/test_small_order.py)
+            assert got == 1, (A.hex(), R.hex())
+
+    # random valid + tampered, and bytes-like message coercion
+    for i in range(8):
+        sk = SigningKey.new(rng)
+        msg = b"fused native %d" % i
+        sig = bytes(sk.sign(msg))
+        vkb = sk.verification_key_bytes().to_bytes()
+        assert native.verify_sig(vkb, sig, msg) == 1
+        assert native.verify_sig(vkb, sig, bytearray(msg)) == 1
+        assert native.verify_sig(vkb, sig, memoryview(msg)) == 1
+        assert native.verify_sig(vkb, sig, msg + b"!") == 0
+        # _sig_k parity with a host-computed challenge
+        h = hashlib.sha512()
+        h.update(sig[:32]); h.update(vkb); h.update(msg)
+        k = scalar.from_hash(h)
+        assert native.verify_sig_k(vkb, sig[:32], sig[32:], k) == 1
+        assert native.verify_sig_k(vkb, sig[:32],
+                                   (int.from_bytes(sig[32:], "little")
+                                    ^ 1).to_bytes(32, "little"), k) == 0
+
+    # malformed key -> -1 (error precedence: even with non-canonical s)
+    bad_vk = b"\x02" + b"\x00" * 31
+    assert edwards.decompress(bad_vk) is None
+    assert native.verify_sig(bad_vk, b"\x01" * 32 + b"\xff" * 32,
+                             b"m") == -1
+    # s >= ell on a VALID key -> 0
+    sk = SigningKey.new(rng)
+    vkb = sk.verification_key_bytes().to_bytes()
+    sig = bytes(sk.sign(b"m"))
+    bad_s = (L + 5).to_bytes(32, "little")
+    assert native.verify_sig(vkb, sig[:32] + bad_s, b"m") == 0
+
+
+def test_fused_single_verify_cache_overflow_unsplit_path():
+    """Past the native per-key table-cache cap (4096 entries) a FRESH
+    key takes the per-call unsplit 65-window Horner — slower, never
+    wrong.  Fill the cache with distinct keys derived from cheap seeds,
+    then pin correctness for keys verified beyond the cap.  The cache
+    is process-global, so the test drops it afterwards (entries are
+    parked, not freed) — later suites must exercise the CACHED split
+    path, not this test's overflow state."""
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+
+    rng2 = random.Random(0xCAFE)
+    # Fill: distinct keys via seeded SigningKeys.  4200 > the 4096 cap.
+    seeds = [rng2.randbytes(32) for _ in range(4200)]
+    msg = b"overflow"
+    last_results = []
+    for i, seed in enumerate(seeds):
+        sk = SigningKey.from_bytes(seed)
+        sig = bytes(sk.sign(msg))
+        vkb = sk.verification_key_bytes().to_bytes()
+        r = native.verify_sig(vkb, sig, msg)
+        last_results.append(r)
+        if i >= 4150 and i % 7 == 0:
+            # beyond (or straddling) the cap: tampering must still fail
+            assert native.verify_sig(vkb, sig, msg + b"x") == 0
+    assert all(r == 1 for r in last_results)
+    dropped = native.vk_cache_drop()
+    assert dropped is not None and dropped >= 4096  # the cap was reached
+    # cached split path works again after the drop
+    sk = SigningKey.from_bytes(rng2.randbytes(32))
+    sig = bytes(sk.sign(msg))
+    vkb = sk.verification_key_bytes().to_bytes()
+    assert native.verify_sig(vkb, sig, msg) == 1
+    assert native.verify_sig(vkb, sig, msg) == 1  # second sight: cache hit
