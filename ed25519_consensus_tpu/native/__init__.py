@@ -267,6 +267,24 @@ def decompress_batch(encodings):
     return [edwards.decompress(e) for e in encodings]
 
 
+def decompress_valid(enc32: bytes):
+    """Validity-only ZIP215 decompression check for ONE encoding: True /
+    False, or NotImplemented without the library (callers fall back to
+    the Point-building path).  The fused verify paths re-derive (or
+    cache) the point natively, so parse-time validation does not need a
+    Python Point at all."""
+    lib = load()
+    if lib is None:
+        return NotImplemented
+    enc32 = bytes(enc32)
+    if len(enc32) != 32:
+        return False
+    out = ctypes.create_string_buffer(128)
+    ok = ctypes.create_string_buffer(1)
+    lib.zip215_decompress_batch(enc32, 1, out, ok, None)
+    return ok.raw[0] == 1
+
+
 def decompress_batch_buffer(blob: bytes, n: int,
                             return_hints: bool = False):
     """Batched ZIP215 decompression, buffer form: `blob` is n

@@ -81,14 +81,38 @@ class VerificationKey:
     ZIP215 criteria for the encoded key `A_bytes`: it MUST decompress to a
     point on the curve, and non-canonical encodings MUST be accepted."""
 
-    __slots__ = ("A_bytes", "minus_A", "_mA_row")
+    __slots__ = ("A_bytes", "_minus_A", "_mA_row")
 
-    def __init__(self, A_bytes: VerificationKeyBytes, minus_A: edwards.Point):
+    def __init__(self, A_bytes: VerificationKeyBytes,
+                 minus_A: "edwards.Point | None" = None):
         self.A_bytes = A_bytes
-        self.minus_A = minus_A
+        # minus_A may arrive pre-computed (signing-key derivation) or be
+        # derived lazily from the VALIDATED encoding on first access —
+        # the fused native verify path never touches the Python Point,
+        # so wire-cold verifies skip its construction entirely.
+        self._minus_A = minus_A
         # lazily-cached 128-byte raw row of −A for the row-based native
         # verify path (deterministic from minus_A, never stale)
         self._mA_row = None
+
+    @property
+    def minus_A(self) -> "edwards.Point":
+        A = self._minus_A
+        if A is None:
+            from . import native
+
+            # Re-decompression here (instead of keeping the row computed
+            # at parse time) costs ~4 µs on the rare paths that need the
+            # Python Point (verify_prehashed, large-message verify); the
+            # common fused path never materializes it at all.
+            A = native.decompress_batch([self.A_bytes.to_bytes()])[0]
+            if A is None:
+                # Unreachable for keys built via from_bytes (validated at
+                # parse); fails loudly if a caller hand-constructs a
+                # VerificationKey around an unvalidated encoding.
+                raise MalformedPublicKey()
+            A = self._minus_A = A.neg()
+        return A
 
     @classmethod
     def from_bytes(cls, data) -> "VerificationKey":
@@ -101,10 +125,15 @@ class VerificationKey:
             vkb = VerificationKeyBytes(data)
         from . import native
 
-        A = native.decompress_batch([vkb.to_bytes()])[0]
-        if A is None:
+        valid = native.decompress_valid(vkb.to_bytes())
+        if valid is NotImplemented:
+            A = native.decompress_batch([vkb.to_bytes()])[0]
+            if A is None:
+                raise MalformedPublicKey()
+            return cls(vkb, A.neg())
+        if not valid:
             raise MalformedPublicKey()
-        return cls(vkb, A.neg())
+        return cls(vkb)
 
     @classmethod
     def from_signing_key(cls, sk) -> "VerificationKey":
