@@ -1,0 +1,59 @@
+"""Forced-device verdict soak on the real chip (round 5): mixed valid / tampered /
+small-order batches through verify_many(hybrid=False) — the round-5
+full-chunk pipeline — checking exact agreement with per-call verdicts."""
+import os, random, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu import (InvalidSignature, Signature, SigningKey,
+                                   batch)
+from ed25519_consensus_tpu.ops import edwards
+from ed25519_consensus_tpu.utils import fixtures
+
+rng = random.Random(0xDEC5)
+keys = [SigningKey.new(rng) for _ in range(48)]
+encs = [p.compress() for p in edwards.eight_torsion()]
+encs += fixtures.non_canonical_point_encodings()[:6]
+
+def make_batch(i):
+    bv = batch.Verifier()
+    n = rng.randrange(20, 400)
+    bad = rng.random() < 0.5
+    bad_at = rng.randrange(n) if bad else -1
+    for j in range(n):
+        if rng.random() < 0.05:
+            A = rng.choice(encs); R = rng.choice(encs)
+            bv.queue((A, Signature(R, b"\x00" * 32), b"Zcash"))  # valid ZIP215
+            continue
+        sk = rng.choice(keys)
+        m = b"soak %d %d" % (i, j)
+        sig = sk.sign(m)
+        if j == bad_at:
+            m = m + b"!"  # tamper
+        bv.queue((sk.verification_key_bytes(), sig, m))
+    return bv, not bad
+
+vs, want = [], []
+for i in range(24):
+    v, w = make_batch(i)
+    vs.append(v); want.append(w)
+
+# warm the device shapes (pad classes vary with n)
+batch.warm_device_shapes(vs[0], chunk=8)
+batch.reset_device_health()
+t0 = time.time()
+got = batch.verify_many([v.clone() for v in vs], rng=rng, hybrid=False,
+                        merge="never")
+dt = time.time() - t0
+s = dict(batch.last_run_stats)
+print(f"# verdicts in {dt:.1f}s: device {s.get('device_batches')} / host "
+      f"{s.get('host_batches')} (sick={s.get('device_sick')})")
+assert got == want, [i for i, (g, w) in enumerate(zip(got, want)) if g != w]
+# cross-check per-call oracle
+for v, w in zip(vs, want):
+    try:
+        v.clone().verify(rng=rng, backend="host")
+        assert w, "host accepted a tampered batch"
+    except InvalidSignature:
+        assert not w, "host rejected a valid batch"
+print("DEVICE_SOAK_OK", len(vs), "batches")
+os._exit(0)
