@@ -9,7 +9,7 @@ device meshes for large batches.
 
 Public surface mirrors reference src/lib.rs:6-16."""
 
-from . import batch, serde
+from . import batch, faults, health, serde
 from .error import (
     Error,
     InvalidSignature,
@@ -41,5 +41,7 @@ __all__ = [
     "VerificationKey",
     "VerificationKeyBytes",
     "batch",
+    "faults",
+    "health",
     "serde",
 ]

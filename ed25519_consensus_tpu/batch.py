@@ -104,6 +104,23 @@ class Item:
         )
 
 
+def _evict_one(cache: dict) -> None:
+    """Drop one (oldest-inserted) entry, FIFO-style, tolerating races:
+    `pop(next(iter(cache)))` is a non-atomic read-then-pop, and two
+    threads verifying concurrently at a cache's cap could otherwise
+    KeyError and fail a valid batch (ADVICE r5).  `pop(key, None)`
+    absorbs a doubly-picked victim; StopIteration/RuntimeError mean a
+    racing thread already emptied/resized the dict — either way someone
+    made room, which is all eviction is for.  Entries in every cache
+    below are deterministic pure functions of their key, so WHICH entry
+    goes (and who wins a racing double-insert) can never affect a
+    verdict — only a recompute."""
+    try:
+        cache.pop(next(iter(cache)), None)
+    except (StopIteration, RuntimeError):
+        pass
+
+
 # [2^128]A per verification key, for the device MSM's uniform-128-bit
 # scalar split (ops/msm.py).  Keyed by the 32-byte encoding; values are
 # deterministic exact host points, so the cache can never go stale.  In
@@ -136,7 +153,7 @@ def _shift128_for_key(vk_bytes: bytes, A_row) -> "tuple":
         enc, hint = edwards.compress_with_hint(pt)
         sp = (pt, enc, hint)
         if len(_shift128_cache) >= _SHIFT_CACHE_MAX:
-            _shift128_cache.pop(next(iter(_shift128_cache)))
+            _evict_one(_shift128_cache)
         _shift128_cache[vk_bytes] = sp
     return sp
 
@@ -217,7 +234,7 @@ def _key_rows_for(keys) -> "bytes | None":
         for j, i in enumerate(missing):
             row = raw[j].tobytes()
             if len(_key_row_cache) >= _KEY_ROW_CACHE_MAX:
-                _key_row_cache.pop(next(iter(_key_row_cache)))
+                _evict_one(_key_row_cache)
             _key_row_cache[keys[i].to_bytes()] = row
             rows[i] = row
     return b"".join(rows)
@@ -292,7 +309,7 @@ def _split_operands_for(keys) -> "tuple | None":
             e = (sh, native.msm_build_table(row)
                  + native.msm_build_table(sh))
             if len(_host_split_cache) >= _HOST_SPLIT_CACHE_MAX:
-                _host_split_cache.pop(next(iter(_host_split_cache)))
+                _evict_one(_host_split_cache)
             _host_split_cache[kb] = e
             entries[i] = e
         if any(e is None for e in entries):
@@ -332,7 +349,7 @@ def _keyset_operands_for(keys_t: tuple):
     split = _split_operands_for(keys)
     if split is not None:
         if len(_keyset_blob_cache) >= _KEYSET_BLOB_CACHE_MAX:
-            _keyset_blob_cache.pop(next(iter(_keyset_blob_cache)))
+            _evict_one(_keyset_blob_cache)
         _keyset_blob_cache[keys_t] = (key_rows, split)
     return key_rows, split
 
@@ -1017,36 +1034,28 @@ class Verifier:
         self.verify(rng=rng, backend="device")
 
 
-# Device health: after a chunk misses its deadline, skip the device lane
-# entirely until this monotonic time (a seized tunnel can block even
-# launches for tens of seconds — retrying it every call is ruinous).
-_device_cooldown_until = [0.0]
-_device_lane_stuck = [False]
-# After a call where the probe completed but the device won zero batches,
-# skip probing for a while (the probe costs real host time every call).
-_device_uncompetitive_until = [0.0]
-# Consecutive verify_many calls whose probe never RESOLVED (no timing
-# measurement, no device win — e.g. a permanently degraded link where the
-# host drains the pool before every probe returns, or a probe that errors
-# every call).  One unresolved probe is not evidence (the kernel may have
-# been cold-compiling); a streak is — after _UNRESOLVED_PROBE_LIMIT of
-# them a SHORTER re-probe backoff arms, bounding the per-call probe tax
-# (staging + dispatch of a full-chunk probe) that a degraded link would
-# otherwise pay on every single call forever.
-_unresolved_probe_streak = [0]
-# Grace the host-race gives a YOUNG fully-overtaken probe to deliver its
-# timing before being discarded (seconds).  A call younger than this is
-# running the warm kernel, not a minutes-long first-shape compile, so a
-# short wait usually converts an about-to-be-unresolved probe into a
-# measured EMA.  Mutable for tests: on the forced-cpu suite a co-tenant
-# load can stretch the virtual kernel call past any fixed small value.
-_young_probe_grace = [3.0]
-_UNRESOLVED_PROBE_LIMIT = 2
-_UNRESOLVED_PROBE_PAUSE = 30.0
+# Device health (round 6): the module-global single-element health lists
+# that lived here through round 5 (_device_cooldown_until and friends)
+# are gone.  All cooldown/pause/probe state lives in per-mesh
+# health.DeviceHealth objects with an injectable monotonic Clock — see
+# ed25519_consensus_tpu/health.py for the state machine and the
+# documented thread-semantics contract; faults.py is the matching
+# fault-injection seam at the device dispatch boundary.  Back-compat:
+# the old list names still resolve through the module __getattr__ shim
+# at the bottom of this file, as live views of the default-mesh health.
+from . import faults as _faults  # noqa: E402  (belongs with the lane)
+from . import health as _health  # noqa: E402
+from .health import DeviceHealth  # noqa: E402,F401  (re-exported API)
+from .utils import metrics as _metrics  # noqa: E402
+
+_UNRESOLVED_PROBE_LIMIT = DeviceHealth.UNRESOLVED_PROBE_LIMIT
+_UNRESOLVED_PROBE_PAUSE = DeviceHealth.UNRESOLVED_PROBE_PAUSE
 
 # Observability (SURVEY.md §5): counters for the most recent verify_many
-# call — batch/signature totals, the device/host lane split, and wall
-# time.  Read-only snapshot; refreshed on every call.
+# call — batch/signature totals, the device/host lane split, per-call
+# fault/recovery counts, and wall time.  Read-only snapshot; refreshed
+# on every call (process-cumulative fault counters live in
+# utils.metrics.fault_counters).
 last_run_stats = {}
 
 _PENDING = object()
@@ -1075,15 +1084,35 @@ class _DeviceLane:
     _instance_lock = threading.Lock()
 
     @classmethod
-    def get(cls, mesh: int = 0) -> "_DeviceLane":
-        # mesh <= 1 dispatches identically to single-device: normalize so
-        # mode 1 and mode 0 share a lane, its shapes, and its grace state.
-        mesh = int(mesh) if mesh and int(mesh) > 1 else 0
+    def get(cls, mesh: int = 0,
+            health: "DeviceHealth | None" = None) -> "_DeviceLane":
+        mesh = _health.normalize_mesh(mesh)
+        if health is None:
+            health = _health.health_for(mesh)
         # Two concurrent same-mode callers must not each build a lane.
         with cls._instance_lock:
             inst = cls._instances.get(mesh)
+            if inst is not None and inst.healthy() \
+                    and inst._health is not health:
+                # A caller injected a different health/clock (tests):
+                # retire the old worker — its queue drains to the poison
+                # sentinel — and build a lane on the new one.  The
+                # retired lane follows the abandon discipline: marked
+                # unhealthy (never handed out again) and parked in the
+                # side registry so the reset_all drains still join a
+                # worker that is mid-call when retired (an untracked
+                # live worker at interpreter teardown is exactly the
+                # crash the side registry exists to prevent).  NOT
+                # lane_stuck: retirement is not evidence of a wedged
+                # worker; reset_all marks stuck if it refuses to die.
+                inst._q.put(None)
+                inst._abandoned = True
+                if inst._thread.is_alive() \
+                        and inst not in cls._abandoned_instances:
+                    cls._abandoned_instances.append(inst)
+                inst = None
             if inst is None or not inst.healthy():
-                inst = cls(mesh=mesh)
+                inst = cls(mesh=mesh, health=health)
                 cls._instances[mesh] = inst
             return inst
 
@@ -1122,7 +1151,7 @@ class _DeviceLane:
                     # calling abandon() here would re-take the held
                     # non-reentrant _instance_lock)
                     inst._abandoned = True
-                    _device_lane_stuck[0] = True
+                    inst._health.mark_lane_stuck()
                     if cls._instances.get(mode) is inst:
                         del cls._instances[mode]
                     if inst not in cls._abandoned_instances:
@@ -1141,11 +1170,15 @@ class _DeviceLane:
                     cls._abandoned_instances.remove(inst)
         return all_dead
 
-    def __init__(self, mesh: int = 0):
+    def __init__(self, mesh: int = 0,
+                 health: "DeviceHealth | None" = None):
         import queue
         import threading
 
-        self._mesh = int(mesh or 0)
+        self._mesh = _health.normalize_mesh(mesh)
+        self._health = health if health is not None \
+            else _health.health_for(self._mesh)
+        self._clock = self._health.clock
         self._q = queue.Queue()
         self._results = {}
         self._discarded = set()
@@ -1187,22 +1220,25 @@ class _DeviceLane:
 
     def wait(self, cid: int, timeout: float):
         """(result array or None on device error, call_seconds) tuple, or
-        _PENDING on timeout."""
-        import time as _time
-
-        end = _time.monotonic() + timeout
+        _PENDING on timeout.  The deadline runs on the lane's health
+        clock; a VIRTUAL clock only advances explicitly, so the wait
+        polls in short real slices instead of sleeping the whole (never
+        self-elapsing) timeout — a result or an `advance()` past the
+        deadline ends it, host load never does."""
+        clock = self._clock
+        end = clock.monotonic() + timeout
         with self._cv:
             while cid not in self._results:
-                left = end - _time.monotonic()
+                left = end - clock.monotonic()
                 if left <= 0:
                     return (self._results.pop(cid)
                             if cid in self._results else _PENDING)
-                self._cv.wait(left)
+                self._cv.wait(0.01 if clock.virtual else left)
             return self._results.pop(cid)
 
     def abandon(self) -> None:
         self._abandoned = True
-        _device_lane_stuck[0] = True
+        self._health.mark_lane_stuck()
         # Deregister only if the registry still holds THIS lane: a second
         # caller's stale abandon must not discard a freshly rebuilt
         # healthy lane (and orphan its worker).  The lane moves to the
@@ -1223,10 +1259,9 @@ class _DeviceLane:
         self._thread.join(timeout)
 
     def _run(self):
-        import time as _time
-
         from .ops import msm as _msm
 
+        clock = self._clock
         while True:
             item = self._q.get()
             if item is None:
@@ -1244,22 +1279,37 @@ class _DeviceLane:
                 # One critical section across launch + blocking fetch (the
                 # lock is reentrant; ops.msm's dispatch re-acquires it).
                 with _msm.DEVICE_CALL_LOCK:
-                    t_call = _time.monotonic()
+                    t_call = clock.monotonic()
                     with self._cv:
                         self._started[cid] = t_call
                     if self._mesh > 1:
                         from .parallel import sharded_msm as _sh
 
-                        out = np.asarray(_sh.sharded_window_sums_many(
-                            digits, pts, self._mesh))
+                        def _call(sh=_sh):
+                            return np.asarray(sh.sharded_window_sums_many(
+                                digits, pts, self._mesh, clock=clock))
                     else:
-                        out = np.asarray(
-                            _msm.dispatch_window_sums_many(digits, pts)
-                        )
+                        def _call():
+                            return np.asarray(
+                                _msm.dispatch_window_sums_many(digits, pts))
+                    # Every device call passes through the fault-injection
+                    # seam (a no-op unless a faults.FaultPlan is
+                    # installed) — THE place deterministic error/stall/
+                    # corruption/lane-death faults land.
+                    out = np.asarray(_faults.run_device_call(
+                        _faults.SITE_LANE, _call, mesh=self._mesh,
+                        clock=clock))
                 # Fetch done ⇒ any first-compile for this shape is over:
                 # subsequent calls are held to the normal deadline.
                 _msm.mark_shape_completed(digits.shape[0], digits.shape[2],
                                           self._mesh)
+            except _faults.LaneDeathSignal:
+                # Injected mid-flight thread death: exit WITHOUT reporting
+                # a result or clearing _started — callers see an in-flight
+                # call that never returns (the deadline machinery takes
+                # over) and healthy() goes False, so the next get()
+                # builds a fresh lane.
+                return
             except Exception:  # device error: caller decides on host
                 import os as _os
 
@@ -1271,7 +1321,7 @@ class _DeviceLane:
             # Report the CALL duration (lock acquired → fetch done), not
             # submit-to-finish: with 2 chunks pipelined, queue time would
             # inflate the turnaround EMA ~2× and bench a healthy device.
-            call_dt = (_time.monotonic() - t_call) if t_call is not None \
+            call_dt = (clock.monotonic() - t_call) if t_call is not None \
                 else 0.0
             with self._cv:
                 self._started.pop(cid, None)
@@ -1297,14 +1347,12 @@ atexit.register(_shutdown_device_lane)
 
 
 def reset_device_health() -> None:
-    """Clear the device health state (deadline cooldown, uncompetitive
-    pause, stuck flag).  For benches and long-running services that know
-    a transient condition (tunnel outage, cold kernel compile) has
-    passed and want the next verify_many to probe the device again."""
-    _device_cooldown_until[0] = 0.0
-    _device_uncompetitive_until[0] = 0.0
-    _device_lane_stuck[0] = False
-    _unresolved_probe_streak[0] = 0
+    """Clear the device health state for EVERY mesh (deadline cooldown,
+    uncompetitive pause, probe streak, stuck flags).  For benches and
+    long-running services that know a transient condition (tunnel
+    outage, cold kernel compile) has passed and want the next
+    verify_many to probe the device again."""
+    _health.reset_all()
 
 
 def device_lane_stuck() -> bool:
@@ -1312,7 +1360,82 @@ def device_lane_stuck() -> bool:
     stuck worker may be blocked inside the accelerator runtime; callers
     that are about to exit the process should prefer os._exit to avoid
     crashing in native teardown."""
-    return _device_lane_stuck[0]
+    return _health.any_lane_stuck()
+
+
+def health_for(mesh: int = 0) -> "DeviceHealth":
+    """The process DeviceHealth for a dispatch mode (re-export of
+    health.health_for — the object verify_many consults when no
+    explicit `health` is passed)."""
+    return _health.health_for(mesh)
+
+
+class _HealthFieldProxy:
+    """List-like live view of one default-mesh DeviceHealth field, for
+    back-compat with the retired module-global single-element health
+    lists (`batch._young_probe_grace[0]` and friends): `[0]`
+    reads/writes the health object directly.  No state lives here — the
+    proxy is constructed fresh on every attribute access."""
+
+    __slots__ = ("_field",)
+
+    def __init__(self, field: str):
+        self._field = field
+
+    def _check(self, i):
+        if i != 0:
+            raise IndexError(i)
+
+    def __getitem__(self, i):
+        self._check(i)
+        return getattr(_health.health_for(0), self._field)
+
+    def __setitem__(self, i, value):
+        self._check(i)
+        setattr(_health.health_for(0), self._field, value)
+
+    def __len__(self):
+        return 1
+
+    def __repr__(self):
+        return f"[{self[0]!r}]"
+
+
+class _LaneStuckProxy(_HealthFieldProxy):
+    """`_device_lane_stuck[0]` meant the PROCESS flag (all lanes, all
+    meshes), not mesh-0's — so the proxy reads `health.any_lane_stuck`
+    (what `device_lane_stuck()` reports) and writes through
+    `health.set_any_lane_stuck` (False clears the latch and every
+    mesh's flag, the old reset idiom's meaning)."""
+
+    def __init__(self):
+        super().__init__("lane_stuck")
+
+    def __getitem__(self, i):
+        self._check(i)
+        return _health.any_lane_stuck()
+
+    def __setitem__(self, i, value):
+        self._check(i)
+        _health.set_any_lane_stuck(bool(value))
+
+
+_HEALTH_FIELD_SHIMS = {
+    "_device_cooldown_until": "cooldown_until",
+    "_device_uncompetitive_until": "uncompetitive_until",
+    "_unresolved_probe_streak": "unresolved_probe_streak",
+    "_young_probe_grace": "young_probe_grace",
+}
+
+
+def __getattr__(name):  # PEP 562 back-compat shim
+    if name == "_device_lane_stuck":
+        return _LaneStuckProxy()
+    field = _HEALTH_FIELD_SHIMS.get(name)
+    if field is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    return _HealthFieldProxy(field)
 
 
 # Union-merge policy (verify_many): batches whose average size is at most
@@ -1416,7 +1539,8 @@ def _merge_groups(verifiers):
 
 def verify_many(verifiers, rng=None, chunk: int = 8,
                 hybrid: bool = True, merge: str = "auto",
-                mesh: int | None = None) -> "list[bool]":
+                mesh: int | None = None,
+                health: "DeviceHealth | None" = None) -> "list[bool]":
     """Verify MANY independent batches with union-merging, chunked
     double-buffered device calls, and an opportunistic host lane.
 
@@ -1440,10 +1564,28 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     Returns a verdict per verifier (True = every queued signature valid);
     each verdict is decided by the same exact host math as `verify`
     (staging rejections included — a batch that fails host staging is
-    simply verdict False here)."""
+    simply verdict False here).  A device REJECT is never a verdict by
+    itself: it is re-decided by the exact host path first, so even a
+    corrupted device result cannot fail a valid batch (see
+    docs/failure-model.md for the full degradation ladder).
+
+    `health` injects the per-mesh DeviceHealth (cooldowns, probe
+    backoff, young-probe grace) and its monotonic clock; default is the
+    process health_for(mesh).  All scheduling time — deadlines, grace,
+    EMA, host-lane medians — runs on that clock, which is what lets
+    tests drive the failure machinery with health.FakeClock instead of
+    wall-time bounds."""
     import time as _time
 
     from .ops import msm
+
+    # mesh <= 1 is single-device dispatch: normalize EARLY so the lane,
+    # the health object, the shard padding, and the shape-completed
+    # grace keys all agree with the mesh=None path.
+    mesh = _health.normalize_mesh(mesh)
+    if health is None:
+        health = _health.health_for(mesh)
+    now = health.now
 
     verifiers = list(verifiers)
     if merge not in ("auto", "never", "always"):
@@ -1462,7 +1604,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             t0 = _time.monotonic()
             union_verdicts = verify_many(
                 unions, rng=rng, chunk=chunk, hybrid=hybrid,
-                merge="never", mesh=mesh
+                merge="never", mesh=mesh, health=health
             )
             stats = dict(last_run_stats)
             verdicts = [False] * len(verifiers)
@@ -1499,31 +1641,46 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         "device_sick": False,
         "device_measured": False,  # a chunk completed and updated the EMA
         "probed": False,  # a probe chunk was actually dispatched
+        "device_errors": 0,  # error chunks (device raised; host decided)
+        # Device rejects re-decided on the host, split by outcome: a
+        # CONFIRMED reject is the device detecting a genuinely bad batch
+        # (benign); an OVERTURNED one is the host restoring a valid
+        # batch a corrupted device result tried to fail — the direct
+        # corruption signal operators should alert on.
+        "device_rejects_confirmed": 0,
+        "device_rejects_overturned": 0,
         "seconds": 0.0,
     }
 
     def _finish(result):
         stats["seconds"] = _time.monotonic() - _t_begin
-        if (stats["batches"] >= 8 and stats["device_batches"] == 0
+        # Device PARTICIPATION, not wins: host-re-decided rejects count —
+        # a device correctly rejecting an invalid-spam stream completed
+        # its chunks and is working, and must not measure as
+        # "uncompetitive" just because every verdict was finalized on
+        # the host (rejects stopped counting as device_batches when
+        # host confirmation landed).
+        participated = (stats["device_batches"]
+                        + stats["device_rejects_confirmed"]
+                        + stats["device_rejects_overturned"])
+        if (stats["batches"] >= 8 and participated == 0
                 and not stats["device_sick"] and stats["host_batches"]):
             if stats.get("device_measured"):
                 # the device was MEASURED and still lost every race this
                 # call: pause probing.
-                _device_uncompetitive_until[0] = _time.monotonic() + 60.0
-                _unresolved_probe_streak[0] = 0
+                health.note_uncompetitive()
             elif stats.get("probed"):
                 # The probe never resolved (no timing, no win — compile
                 # still in flight, a seized-but-not-sick link, or an
                 # error every call).  One is not evidence (the next call
-                # probes the now-warm kernel); a STREAK is — arm a
-                # shorter backoff so a permanently degraded link stops
-                # paying a full-chunk probe on every call.
-                _unresolved_probe_streak[0] += 1
-                if _unresolved_probe_streak[0] >= _UNRESOLVED_PROBE_LIMIT:
-                    _device_uncompetitive_until[0] = (
-                        _time.monotonic() + _UNRESOLVED_PROBE_PAUSE)
-        elif stats.get("device_measured") or stats["device_batches"]:
-            _unresolved_probe_streak[0] = 0
+                # probes the now-warm kernel); a STREAK is — the health
+                # object arms a shorter backoff at the limit, so a
+                # permanently degraded link stops paying a full-chunk
+                # probe on every call.
+                if health.note_unresolved_probe():
+                    _metrics.record_fault("probe_backoff_armed")
+        elif stats.get("device_measured") or participated:
+            health.note_probe_resolved()
         last_run_stats.clear()
         last_run_stats.update(stats)
         return result
@@ -1541,14 +1698,14 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if decided[i]:
             return
         decided[i] = 1
-        t0 = _time.monotonic()
+        t0 = now()
         # _host_verdict routes through verify(backend="host") — the
         # fused one-native-call path when the verifier's queue-order
         # buffers are live, the staged path otherwise.
         verdicts[i] = _host_verdict(verifiers[i], rng)
         stats["host_batches"] += 1
         if len(_host_times) < 64:
-            _host_times.append(_time.monotonic() - t0)
+            _host_times.append(now() - t0)
 
     def stage_chunk(vs_idx):
         staged, idxs = [], []
@@ -1617,19 +1774,16 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     if (_os.environ.get("ED25519_TPU_DISABLE_DEVICE", "").lower()
             in ("1", "true", "yes")  # explicit opt-outs only, like
             #                          ED25519_TPU_DISABLE_NATIVE
-            or _time.monotonic() < _device_cooldown_until[0]
-            or _time.monotonic() < _device_uncompetitive_until[0]):
+            or not health.device_allowed()):
         # ED25519_TPU_DISABLE_DEVICE: config knob (SURVEY.md §5) forcing
         # the pure-host lane — also keeps jax entirely unloaded, which on
-        # small hosts frees a measurable slice of the core.
+        # small hosts frees a measurable slice of the core.  The health
+        # gate covers both the deadline cooldown and the uncompetitive/
+        # unresolved-probe pause for THIS mesh.
         while remaining:
             host_verify_one(remaining.pop())
         return _finish(verdicts)
-    # mesh <= 1 is single-device dispatch: normalize so the lane, the
-    # shard padding, and the shape-completed grace keys all agree with
-    # the mesh=None path.
-    mesh = int(mesh) if mesh and int(mesh) > 1 else 0
-    dev = _DeviceLane.get(mesh=mesh)
+    dev = _DeviceLane.get(mesh=mesh, health=health)
 
     # Seconds-per-batch prior before the first measurement; the deadline
     # budget is 3×EMA×batches (2 s floor).  The default fits real TPU
@@ -1656,7 +1810,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         idxs, digits, pts = pending
         cid = dev.submit(digits, pts)
         # (chunk id, real batch idxs, submit time, padded shape (B, N))
-        outstanding.append((cid, idxs, _time.monotonic(),
+        outstanding.append((cid, idxs, now(),
                             digits.shape[0], digits.shape[2]))
 
     def poll(block: bool):
@@ -1684,18 +1838,18 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             t_start = dev.started_at(cid)
             deadline = (t_start + budget) if t_start is not None \
                 else (t0 + budget + 10.0)
-            timeout = max(0.0, deadline - _time.monotonic()) if block \
-                else 0.0
+            timeout = max(0.0, deadline - now()) if block else 0.0
             res = dev.wait(cid, timeout)
             if res is _PENDING:
                 t_start = dev.started_at(cid)
                 deadline = (t_start + budget) if t_start is not None \
                     else (t0 + budget + 10.0)
-                if _time.monotonic() < deadline:
+                if now() < deadline:
                     return progress
                 device_sick = True  # missed deadline
                 stats["device_sick"] = True
-                _device_cooldown_until[0] = _time.monotonic() + 30.0
+                health.note_deadline_miss()
+                _metrics.record_fault("deadline_miss")
                 dev.abandon()
                 for _, idxs2, _t, _b, _nl in outstanding:
                     for i in idxs2:
@@ -1707,6 +1861,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             if out is None:  # device error: host decides, device benched
                 device_failed = True  # don't trust an error turnaround as
                 #                       a competitive EMA measurement
+                stats["device_errors"] += 1
+                _metrics.record_fault("device_error")
                 for i in idxs:
                     host_verify_one(i)
             else:
@@ -1723,10 +1879,33 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 for j, i in enumerate(idxs):
                     if decided[i]:
                         continue  # host stole this batch back first
-                    decided[i] = 1
-                    stats["device_batches"] += 1
                     check = msm.combine_window_sums(out[j])
-                    verdicts[i] = check.mul_by_cofactor().is_identity()
+                    if check.mul_by_cofactor().is_identity():
+                        decided[i] = 1
+                        stats["device_batches"] += 1
+                        verdicts[i] = True
+                    else:
+                        # Device REJECT: never a verdict by itself.  The
+                        # accept direction is protected by exact host
+                        # staging plus the 2^-128 RLC bound, but a
+                        # reject can be MANUFACTURED by a corrupted
+                        # device sum (bad HBM/ICI bits, a miscompiled
+                        # kernel) — so the degradation ladder re-decides
+                        # it with the exact host path before any batch
+                        # is failed.  Honest devices hit this only on
+                        # genuinely bad batches (rare by assumption), so
+                        # the all-valid fast path pays nothing.
+                        host_verify_one(i)
+                        if verdicts[i]:
+                            # host OVERTURNED the reject: corruption
+                            # evidence, not signature rejection
+                            stats["device_rejects_overturned"] += 1
+                            _metrics.record_fault(
+                                "device_reject_overturned")
+                        else:
+                            stats["device_rejects_confirmed"] += 1
+                            _metrics.record_fault(
+                                "device_reject_confirmed")
             progress = True
         return progress
 
@@ -1799,12 +1978,19 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                             # young enough is running the kernel, not a
                             # minutes-long first-shape compile).
                             resolved = False
-                            grace = _young_probe_grace[0]
+                            grace = health.young_probe_grace
                             t_start = dev.started_at(cid)
-                            elapsed = (_time.monotonic() - t_start
-                                       if t_start is not None else None)
-                            if (ema_is_prior and elapsed is not None
-                                    and elapsed < grace):
+                            # A probe the worker has not even ENTERED yet
+                            # ages from its SUBMIT time: a fast host can
+                            # drain the whole pool before the lane thread
+                            # is scheduled at all, and discarding that
+                            # probe as "unresolved" would count scheduler
+                            # jitter as device evidence (the r5 flake's
+                            # root shape) — the streak machinery exists
+                            # for probes that genuinely never resolve.
+                            elapsed = now() - (
+                                t_start if t_start is not None else _t0)
+                            if ema_is_prior and elapsed < grace:
                                 # wait only the REMAINING grace: total
                                 # probe age stays bounded by `grace`,
                                 # not 2x it
@@ -1818,6 +2004,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                                         stats["device_measured"] = True
                                     else:
                                         device_failed = True
+                                        stats["device_errors"] += 1
+                                        _metrics.record_fault(
+                                            "device_error")
                                     resolved = True
                             if not resolved:
                                 dev.discard(cid)

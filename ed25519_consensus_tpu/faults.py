@@ -1,0 +1,361 @@
+"""Deterministic, seedable fault injection for the device dispatch path.
+
+The reference's failure model is adversarial *input* only (all-or-nothing
+batches with per-item fallback, reference src/batch.rs:96-108); this build
+adds a failure model for the *device* — and this module is its first-class
+test seam.  A `FaultPlan` is a deterministic schedule mapping (site,
+device-call index) to an action; `install`ing one makes the two dispatch
+boundaries consult it:
+
+* SITE_LANE — the `_DeviceLane` worker's dispatch (batch.py), covering
+  both the single-device and the mesh lane, and
+* SITE_SHARDED — the sharded all-reduce dispatch
+  (parallel/sharded_msm.sharded_window_sums_many).
+
+Fault classes (the full degradation ladder's inputs):
+
+* `ErrorOn`      — the call raises (a crashing kernel / runtime error).
+* `StallFor`     — the call stalls: virtual clocks advance, real clocks
+                   sleep; optionally holds until `plan.release()` so a
+                   deadline miss is deterministic under fake clocks.
+* `FlappingLink` — alternating up/down windows of calls (a flapping
+                   remote-device tunnel): the "down" windows error.
+* `CorruptSum`   — the call completes but its result array comes back
+                   with deterministically flipped entries (a corrupted
+                   device MSM sum — the fault class the scheduler's
+                   host-confirmation of device rejects exists for).
+* `KillLane`     — the worker thread dies mid-flight (raises
+                   `LaneDeathSignal`, which the lane worker deliberately
+                   does NOT convert into an error result).
+
+Determinism: every action depends only on (plan seed, site, call index).
+Two runs of the same plan over the same call stream inject identically —
+`FaultPlan.schedule()` materializes the decisions for inspection, and
+tools/chaos_soak.py replays randomized plans from a seed.
+
+Soundness note (docs/failure-model.md): no fault class may ever change a
+verdict.  Errors/stalls/flaps/lane deaths only ever REMOVE the device
+from the race — the host decides those batches with the same exact math.
+A corrupted sum can at worst make the device claim "reject", and
+verify_many re-decides every device reject on the host before it can
+become a verdict.
+
+When no plan is installed, `run_device_call` is a tuple read and one
+`is None` check — the production path pays nothing measurable.
+"""
+
+import hashlib
+import random
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+
+__all__ = [
+    "SITE_LANE", "SITE_SHARDED", "InjectedFault", "LaneDeathSignal",
+    "Fault", "ErrorOn", "StallFor", "FlappingLink", "CorruptSum",
+    "KillLane", "FaultPlan", "randomized_plan", "install", "uninstall",
+    "injected", "active_plan", "run_device_call",
+]
+
+SITE_LANE = "lane"
+SITE_SHARDED = "sharded"
+
+
+class InjectedFault(RuntimeError):
+    """The error an injected device fault raises (so tests and the chaos
+    driver can tell injected failures from real ones in logs)."""
+
+
+class LaneDeathSignal(Exception):
+    """Raised through the lane worker to kill it mid-flight.  The worker
+    catches exactly this type and exits WITHOUT reporting a result —
+    modelling a thread death, not a clean error return."""
+
+
+def _stable_seed(*parts) -> int:
+    """A cross-process-deterministic int seed from mixed parts (Python's
+    tuple hashing is randomized per process, so `random.Random(tuple)`
+    would NOT replay across runs)."""
+    digest = hashlib.sha256(repr(parts).encode()).digest()
+    return int.from_bytes(digest[:8], "little")
+
+
+def _as_call_set(on):
+    """Normalize an `on` spec to a membership predicate over call
+    indices: int, iterable of ints, or a callable(index) -> bool."""
+    if callable(on):
+        return on
+    if isinstance(on, int):
+        return frozenset((on,)).__contains__
+    return frozenset(int(i) for i in on).__contains__
+
+
+class Fault:
+    """One fault rule: fires at `site` on the call indices `on`
+    (0-based, counted per site)."""
+
+    def __init__(self, on=0, site: str = SITE_LANE):
+        self.site = site
+        self._fires = _as_call_set(on)
+
+    def fires_on(self, index: int) -> bool:
+        return bool(self._fires(index))
+
+    # Hook points, applied by FaultPlan.run in order:
+    #   before(ctx)      — may stall; may raise to abort the call
+    #   after(ctx, out)  — may transform the completed result
+    def before(self, ctx) -> None:
+        pass
+
+    def after(self, ctx, out):
+        return out
+
+    def kind(self) -> str:
+        return type(self).__name__
+
+
+class ErrorOn(Fault):
+    def before(self, ctx):
+        raise InjectedFault(
+            f"injected device error (site={ctx.site}, call={ctx.index})")
+
+
+class StallFor(Fault):
+    """Stall the call for `seconds`: a virtual clock is advanced (the
+    scheduler's deadline logic sees the time pass instantly and
+    deterministically), a real clock sleeps.  With `hold=True` the call
+    additionally blocks until `plan.release()` (bounded by
+    `hold_timeout` real seconds) — the shape of a seized tunnel, where
+    the call never returns until the process gives up on it."""
+
+    def __init__(self, seconds: float, on=0, site: str = SITE_LANE,
+                 hold: bool = False, hold_timeout: float = 60.0):
+        super().__init__(on=on, site=site)
+        self.seconds = float(seconds)
+        self.hold = hold
+        self.hold_timeout = float(hold_timeout)
+
+    def before(self, ctx):
+        clock = ctx.clock
+        if clock is not None and getattr(clock, "virtual", False):
+            clock.advance(self.seconds)
+        else:
+            time.sleep(self.seconds)
+        if self.hold:
+            ctx.plan._release_event.wait(self.hold_timeout)
+            raise InjectedFault(
+                f"stalled device call abandoned (site={ctx.site}, "
+                f"call={ctx.index})")
+
+
+class FlappingLink(Fault):
+    """A link that flaps with period `period`: calls in every other
+    period-sized window error ("down"), the rest pass ("up").  The first
+    window is up, so a probe on a freshly flapping link still
+    measures."""
+
+    def __init__(self, period: int = 2, site: str = SITE_LANE):
+        if period < 1:
+            raise ValueError("period must be >= 1")
+        super().__init__(on=lambda i, p=period: (i // p) % 2 == 1,
+                         site=site)
+        self.period = period
+
+    def before(self, ctx):
+        raise InjectedFault(
+            f"flapping link down (site={ctx.site}, call={ctx.index})")
+
+
+class CorruptSum(Fault):
+    """Complete the call, then flip `flips` entries in EVERY leading-axis
+    slice of the result array — deterministically from (plan seed, site,
+    call index) — modelling a corrupted device MSM sum (bad HBM/ICI
+    bits, a miscompiled kernel).  Per-slice flipping matters: the lane's
+    result stacks one window-sum tensor per batch, and "the call's
+    result is corrupted" must not let individual batches escape by
+    luck of the flip positions.  Random corruption moves the combined
+    point OFF the 8-torsion coset with overwhelming probability, so a
+    valid batch turns into a device REJECT — which verify_many
+    re-decides on the host (see docs/failure-model.md for why the
+    accept direction is safe)."""
+
+    def __init__(self, on=0, site: str = SITE_LANE, flips: int = 4):
+        super().__init__(on=on, site=site)
+        self.flips = int(flips)
+
+    def after(self, ctx, out):
+        arr = np.array(out, copy=True)  # device arrays: pull + copy
+        rng = random.Random(_stable_seed(
+            ctx.plan.seed, ctx.site, ctx.index, "corrupt"))
+        slices = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
+            else arr.reshape(1, -1)
+        for row in slices:
+            for _ in range(max(1, self.flips)):
+                row[rng.randrange(row.size)] ^= 1 << rng.randrange(12)
+        return arr
+
+
+class KillLane(Fault):
+    """Kill the lane worker mid-flight.  `advance` pre-advances a
+    virtual clock (so the orphaned in-flight chunk's deadline expires
+    deterministically instead of needing wall time to pass)."""
+
+    def __init__(self, on=0, advance: float = 3600.0):
+        super().__init__(on=on, site=SITE_LANE)
+        self.advance = float(advance)
+
+    def before(self, ctx):
+        clock = ctx.clock
+        if clock is not None and getattr(clock, "virtual", False) \
+                and self.advance:
+            clock.advance(self.advance)
+        raise LaneDeathSignal(
+            f"injected lane death (call={ctx.index})")
+
+
+class _CallContext:
+    __slots__ = ("plan", "site", "index", "mesh", "clock")
+
+    def __init__(self, plan, site, index, mesh, clock):
+        self.plan = plan
+        self.site = site
+        self.index = index
+        self.mesh = mesh
+        self.clock = clock
+
+
+class FaultPlan:
+    """A deterministic schedule of faults over the device-call stream.
+
+    Call indices are counted per site (0-based, in dispatch order);
+    every decision is a pure function of (seed, site, index), so a plan
+    replayed over the same call stream injects identically.  Thread
+    safety: the per-site counters are lock-guarded (the lane worker and
+    direct sharded callers may allocate indices concurrently); fault
+    rules themselves are immutable after construction."""
+
+    def __init__(self, faults=(), seed: int = 0):
+        self.faults = list(faults)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._log = []
+        self._release_event = threading.Event()
+
+    def release(self) -> None:
+        """Unblock every `hold`ing StallFor (tests call this after the
+        scheduler has given up on the stalled call)."""
+        self._release_event.set()
+
+    def calls_seen(self, site: str = SITE_LANE) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def injection_log(self) -> "list[tuple]":
+        """(site, index, fault-kind) triples actually applied, in
+        order — the determinism witness tests compare across runs."""
+        with self._lock:
+            return list(self._log)
+
+    def schedule(self, site: str, n_calls: int) -> "list[list[str]]":
+        """The fault kinds that WOULD fire for the first `n_calls` call
+        indices at `site` — pure inspection, no counters touched."""
+        return [
+            [f.kind() for f in self.faults
+             if f.site == site and f.fires_on(i)]
+            for i in range(n_calls)
+        ]
+
+    def _next_index(self, site: str) -> int:
+        with self._lock:
+            i = self._counts.get(site, 0)
+            self._counts[site] = i + 1
+            return i
+
+    def run(self, site: str, fn, *, mesh: int = 0, clock=None):
+        idx = self._next_index(site)
+        fired = [f for f in self.faults
+                 if f.site == site and f.fires_on(idx)]
+        ctx = _CallContext(self, site, idx, mesh, clock)
+        if fired:
+            with self._lock:
+                self._log.extend((site, idx, f.kind()) for f in fired)
+        for f in fired:
+            f.before(ctx)  # may stall and/or raise
+        out = fn()
+        for f in fired:
+            out = f.after(ctx, out)
+        return out
+
+
+def randomized_plan(seed: int, error_rate: float = 0.1,
+                    stall_rate: float = 0.05, stall_seconds: float = 0.05,
+                    corrupt_rate: float = 0.05, flap_period: int = 0,
+                    site: str = SITE_LANE) -> FaultPlan:
+    """A chaos-soak plan: per call index, draw independently (from the
+    seed — deterministic and replayable) whether to error, stall, or
+    corrupt.  Rates are per-call probabilities; `flap_period` > 0 adds a
+    flapping link on top."""
+
+    def drawn(kind, rate):
+        def fires(i, kind=kind, rate=rate):
+            return random.Random(
+                _stable_seed(seed, site, i, kind)).random() < rate
+        return fires
+
+    faults = [
+        ErrorOn(on=drawn("error", error_rate), site=site),
+        StallFor(stall_seconds, on=drawn("stall", stall_rate), site=site),
+        CorruptSum(on=drawn("corrupt", corrupt_rate), site=site),
+    ]
+    if flap_period:
+        faults.append(FlappingLink(period=flap_period, site=site))
+    return FaultPlan(faults, seed=seed)
+
+
+# -- the process-wide injection point -------------------------------------
+
+_active = [None]
+_active_lock = threading.Lock()
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    with _active_lock:
+        if _active[0] is not None:
+            raise RuntimeError("a FaultPlan is already installed")
+        _active[0] = plan
+    return plan
+
+
+def uninstall() -> None:
+    with _active_lock:
+        plan = _active[0]
+        _active[0] = None
+    if plan is not None:
+        plan.release()  # never leave a holding stall blocked
+
+
+def active_plan() -> "FaultPlan | None":
+    return _active[0]
+
+
+@contextmanager
+def injected(plan: FaultPlan):
+    """`with faults.injected(plan): ...` — install for the block,
+    release any holding stalls and uninstall on exit."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+def run_device_call(site: str, fn, *, mesh: int = 0, clock=None):
+    """The seam the dispatch boundaries call: apply the active plan's
+    faults for this (site, call) around `fn`.  No plan → `fn()`."""
+    plan = _active[0]
+    if plan is None:
+        return fn()
+    return plan.run(site, fn, mesh=mesh, clock=clock)

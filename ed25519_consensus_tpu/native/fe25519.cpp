@@ -16,6 +16,10 @@
 #include <cstdlib>
 #include <cstring>
 #include <immintrin.h>
+#if defined(__x86_64__)
+#include <x86intrin.h>  // __rdtsc — not exposed via immintrin.h on every
+//                         gcc/libc combination this builds on
+#endif
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -291,10 +295,14 @@ inline void ge_double(ge &r, const ge &p) {
 // the fallback (and the parity oracle in tests/test_native.py).
 
 // Unsigned little-endian nibble windows of `nw` half-bytes → signed
-// digits in [-8, 8]: d > 8 becomes d - 16 with a carry into the next
-// window, final carry in dig[nw] (identical recoding to
-// ops/limbs._recode_signed on the device path).  Shared by the IFMA
-// batch recoder and the scalar single-verify Horner.
+// digits, final carry in dig[nw].  EQUIVALENT recoding to
+// ops/limbs._recode_signed on the device path but with a DIFFERENT
+// carry threshold: here d > 8 carries, giving digits in [-7, +8]; the
+// device wire carries at v >= 8, giving [-8, +7].  Both are valid for
+// consumers indexing a [0..8] multiples table by |digit|, but these
+// digits are NOT nibble-pack-safe — expand_digits sign-extends the
+// nibble 0x8 to -8, so packing a +8 digit from here would corrupt it.
+// Shared by the IFMA batch recoder and the scalar single-verify Horner.
 static inline void recode_signed_nibbles(const uint8_t *s, int nw,
                                          int8_t *dig) {
     int carry = 0;

@@ -151,16 +151,28 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
     return jax.jit(fn)
 
 
-def sharded_window_sums_many(digits, pts, n_devices: int):
+def sharded_window_sums_many(digits, pts, n_devices: int, clock=None):
     """Batched mesh dispatch (the scheduler's device-lane call when a
     mesh is configured): digits (B, nwin, N), points in any wire format
-    → (B, 4, NLIMBS, nwin) device array."""
+    → (B, 4, NLIMBS, nwin) device array.
+
+    The launch passes through the fault-injection seam (faults.py,
+    SITE_SHARDED — a no-op unless a FaultPlan is installed), so tests
+    can fault the mesh all-reduce independently of the single-device
+    dispatch.  `clock` is the caller's health clock (the device lane
+    passes its own), so clock-aware faults — StallFor's virtual
+    advance — behave identically at both seams."""
+    from .. import faults as _faults
+
     dwire = msm_lib.digit_wire_of(digits)
     nwin = msm_lib.logical_windows(digits)
-    return _compiled_sharded_kernel_many(
+    kernel = _compiled_sharded_kernel_many(
         n_devices, digits.shape[0], digits.shape[2] // n_devices,
         nwin, wire=msm_lib.wire_of(pts), dwire=dwire,
-    )(digits, pts)
+    )
+    return _faults.run_device_call(
+        _faults.SITE_SHARDED, lambda: kernel(digits, pts),
+        mesh=n_devices, clock=clock)
 
 
 def shard_pad(n: int, n_devices: int) -> int:

@@ -1,9 +1,43 @@
 """Lightweight observability for the batch pipeline (SURVEY.md §5: the
 reference has none; the TPU build adds counters for sigs/sec, batch size,
-coalescing ratio m/n, and per-stage wall times)."""
+coalescing ratio m/n, per-stage wall times, and — since the round-6
+robustness work — process-cumulative fault/recovery counters fed by the
+verify_many degradation ladder)."""
 
+import threading
 import time
 from contextlib import contextmanager
+
+# -- fault/recovery counters ----------------------------------------------
+# Process-cumulative tallies of every degradation-ladder transition
+# (batch.verify_many records them; faults.py-injected and real device
+# faults land in the same counters, by design — the ladder cannot tell
+# them apart and the observability should not either).  Per-call counts
+# live in batch.last_run_stats; these survive across calls for soaks and
+# long-running services.  Kinds currently recorded: "device_error",
+# "deadline_miss", "device_reject_confirmed" (host agreed — ordinary
+# signature rejection), "device_reject_overturned" (host restored a
+# valid batch — the corruption signal to alert on), and
+# "probe_backoff_armed".
+
+_fault_lock = threading.Lock()
+_fault_counters: dict = {}
+
+
+def record_fault(kind: str, n: int = 1) -> None:
+    with _fault_lock:
+        _fault_counters[kind] = _fault_counters.get(kind, 0) + n
+
+
+def fault_counters() -> dict:
+    """Snapshot of the process-cumulative fault/recovery counters."""
+    with _fault_lock:
+        return dict(_fault_counters)
+
+
+def reset_fault_counters() -> None:
+    with _fault_lock:
+        _fault_counters.clear()
 
 
 class BatchMetrics:

@@ -1,0 +1,408 @@
+"""Deterministic fault injection at the device dispatch boundary
+(faults.py): every fault class — error, stall, flapping link, corrupted
+device MSM sum, mid-flight lane death — driven through the FULL
+degradation ladder (device fault → cooldown/backoff → host lane →
+per-item bisection), asserting for every class that the verdicts are
+identical to the pure-host path.  The consensus claim under test is
+docs/failure-model.md's: NO fault class can ever change a verdict.
+
+Timing-sensitive scenarios run on health.FakeClock — the injected fault
+advances virtual time, so deadline misses and grace windows are
+deterministic and the tests carry no wall-time bounds.
+"""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import SigningKey, batch, faults, health
+from ed25519_consensus_tpu.ops import msm
+from ed25519_consensus_tpu.utils import metrics
+
+rng = random.Random(0xFA17)
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    yield
+    faults.uninstall()  # never leak a plan (or a holding stall) out
+    batch._DeviceLane.reset_all()
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+
+
+def make_verifiers(n_batches, sigs_per_batch=3, bad=()):
+    """n_batches independent Verifiers; indices in `bad` get one
+    corrupted signature (same construction as tests/test_scheduler.py)."""
+    out = []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        for i in range(sigs_per_batch):
+            sk = SigningKey.new(rng)
+            msg = b"faults-%d-%d" % (b, i)
+            sig = sk.sign(msg if (b not in bad or i != 0) else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        out.append(v)
+    return out
+
+
+def host_verdicts(vs):
+    """The pure-host ground truth: every batch decided by the exact
+    host path (what verify_many must agree with under ANY fault)."""
+    return [batch._host_verdict(v, rng) for v in vs]
+
+
+def mark_shapes_warm(chunk=2, mesh=0, sigs_per_batch=3):
+    """Mark the scheduler's padded chunk shape completed WITHOUT a real
+    dispatch, so faulted calls are held to the normal deadline instead
+    of the first-compile grace (mirrors production warm_device_shapes;
+    no dispatch because the injected fault would intercept it)."""
+    staged = make_verifiers(1, sigs_per_batch=sigs_per_batch)[0]._stage(rng)
+    if mesh and mesh > 1:
+        from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+        pad = shard_pad(staged.n_device_terms, mesh)
+    else:
+        pad = msm.preferred_pad(staged.n_device_terms)
+    msm.mark_shape_completed(chunk, pad, mesh)
+    return pad
+
+
+def warm_kernel_for_chunk(chunk=2):
+    """Really compile the (CPU backend) kernel at the scheduler's padded
+    chunk shape — for fault classes (CorruptSum) whose injected call
+    runs the genuine dispatch underneath."""
+    from ed25519_consensus_tpu.ops import limbs
+
+    n_lanes = mark_shapes_warm(chunk=chunk)
+    digits = np.zeros((chunk, limbs.NWINDOWS, n_lanes), dtype=np.int8)
+    pts = np.stack([limbs.identity_point_batch(n_lanes)] * chunk)
+    np.asarray(msm.dispatch_window_sums_many(digits, pts))
+
+
+ALWAYS = ("every call",)
+
+
+def every_call(i):
+    return True
+
+
+# -- fault class: error ---------------------------------------------------
+
+
+def test_error_fault_verdicts_match_host():
+    """Injected device errors → every batch re-decided on the host;
+    verdicts bit-identical to the pure-host path; fault counters tick.
+    hybrid=False so the errored chunks are deterministically POLLED
+    (with a racing host lane the probe can be legitimately overtaken
+    and discarded before its error resolves)."""
+    mark_shapes_warm()
+    base = metrics.fault_counters().get("device_error", 0)
+    vs = make_verifiers(6, bad={2})
+    hv = host_verdicts(vs)
+    plan = faults.FaultPlan([faults.ErrorOn(on=every_call)])
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                     merge="never")
+    assert verdicts == hv == [i != 2 for i in range(6)]
+    stats = batch.last_run_stats
+    assert stats["device_batches"] == 0
+    assert stats["host_batches"] == 6
+    assert stats["device_errors"] >= 1
+    assert not stats["device_sick"]  # an error is not a stall
+    assert plan.calls_seen(faults.SITE_LANE) >= 1
+    assert metrics.fault_counters()["device_error"] > base
+
+
+# -- fault class: stall (deadline ladder) ---------------------------------
+
+
+def test_stall_fault_walks_the_deadline_ladder():
+    """A stalled call (seized tunnel) on a FAKE clock: deadline miss →
+    device sick → batches re-decided on host → lane abandoned → cooldown
+    armed → the NEXT call skips the device entirely.  Verdicts identical
+    to the pure-host path at every rung."""
+    mark_shapes_warm()
+    h = health.DeviceHealth(clock=health.FakeClock())
+    plan = faults.FaultPlan(
+        [faults.StallFor(1000.0, on=every_call, hold=True)])
+    vs = make_verifiers(5, bad={0})
+    hv = host_verdicts(vs)
+    t0 = h.now()
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                     merge="never", health=h)
+    assert verdicts == hv
+    stats = batch.last_run_stats
+    assert stats["device_sick"] and stats["host_batches"] == 5
+    assert h.cooldown_until > t0 and not h.device_allowed()
+    assert batch.device_lane_stuck()
+    # rung 2: while cooled down, the device lane is never touched
+    vs2 = make_verifiers(4, bad={3})
+    hv2 = host_verdicts(vs2)
+    verdicts2 = batch.verify_many(vs2, rng=rng, chunk=2, merge="never",
+                                  health=h)
+    assert verdicts2 == hv2
+    assert not batch.last_run_stats["probed"]
+    # rung 3: cooldown expires (virtual time), the device is re-admitted
+    h.clock.advance(h.DEADLINE_COOLDOWN + 1.0)
+    assert h.device_allowed()
+
+
+# -- fault class: flapping link -------------------------------------------
+
+
+def test_flapping_link_verdicts_match_host_every_call():
+    """A link that flaps (alternating up/down call windows) across many
+    verify_many calls: whichever window each call lands in, verdicts
+    stay identical to the pure-host path."""
+    warm_kernel_for_chunk()  # up-window calls run the real kernel
+    plan = faults.FaultPlan([faults.FlappingLink(period=1)])
+    saw_error = saw_device_win = False
+    with faults.injected(plan):
+        for call in range(4):
+            vs = make_verifiers(6, bad={call})
+            hv = host_verdicts(vs)
+            # hybrid=False: every chunk is deterministically polled, so
+            # down windows always surface as device_errors and up
+            # windows actually exercise the device verdict path
+            verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                         hybrid=False, merge="never")
+            assert verdicts == hv
+            if batch.last_run_stats["device_errors"]:
+                saw_error = True
+            if batch.last_run_stats["device_batches"]:
+                saw_device_win = True
+            batch.reset_device_health()  # keep every window probing
+    assert saw_error  # the down windows were really exercised
+    assert saw_device_win  # …and the up windows really reached the device
+    assert plan.calls_seen(faults.SITE_LANE) >= 2
+
+
+# -- fault class: corrupted device MSM sum --------------------------------
+
+
+def test_corrupted_sum_cannot_change_any_verdict(monkeypatch):
+    """The sharp end of the fault model: the device call COMPLETES but
+    its window sums come back corrupted.  A corrupted sum turns a valid
+    batch into a device REJECT — which verify_many must re-decide on the
+    host before failing anything — and must leave invalid batches
+    rejected.  Verdicts bit-identical to the pure-host path in both
+    directions."""
+    warm_kernel_for_chunk()
+    # generous EMA prior: a contended CPU-backend kernel call must not
+    # trip the (real-clock) deadline and turn this into a stall test
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    base = metrics.fault_counters().get("device_reject_overturned", 0)
+    vs = make_verifiers(6, bad={1, 4})
+    hv = host_verdicts(vs)
+    plan = faults.FaultPlan([faults.CorruptSum(on=every_call)], seed=0xC0)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                     merge="never")
+    assert verdicts == hv == [i not in (1, 4) for i in range(6)]
+    stats = batch.last_run_stats
+    # every device-processed batch came back corrupted → rejected →
+    # host re-decided; none may be credited to the device lane.  The
+    # observability distinguishes the outcomes: the 4 valid batches are
+    # OVERTURNED rejects (the corruption signal), the 2 bad ones
+    # CONFIRMED rejects (ordinary signature rejection).
+    assert stats["device_batches"] == 0
+    assert stats["device_rejects_overturned"] == 4
+    assert stats["device_rejects_confirmed"] == 2
+    assert stats["host_batches"] == 6
+    assert metrics.fault_counters()["device_reject_overturned"] > base
+
+
+def test_honest_device_reject_is_still_host_confirmed(monkeypatch):
+    """No fault plan at all: a genuinely invalid batch processed by the
+    (real, uncorrupted) device kernel is a device reject — and still
+    goes through host confirmation before the verdict lands False."""
+    warm_kernel_for_chunk()
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    vs = make_verifiers(4, bad={2})
+    hv = host_verdicts(vs)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never")
+    assert verdicts == hv == [i != 2 for i in range(4)]
+    stats = batch.last_run_stats
+    # hybrid=False: every chunk is device-processed, so exactly the bad
+    # batch is a device reject → re-decided (and counted) on the host,
+    # CONFIRMED (the host agrees — no corruption signal)
+    assert stats["device_rejects_confirmed"] == 1
+    assert stats["device_rejects_overturned"] == 0
+    assert stats["host_batches"] == 1
+    assert stats["device_batches"] == 3
+
+
+def test_all_invalid_stream_does_not_bench_device(monkeypatch):
+    """Host-confirmed rejects count as device PARTICIPATION: a stream of
+    >= 8 all-invalid batches (invalid-signature spam — exactly when
+    device throughput matters) is fully reject-confirmed on the host,
+    and the working device must NOT be paused as 'uncompetitive' for
+    winning zero verdicts."""
+    warm_kernel_for_chunk()
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    vs = make_verifiers(8, bad=set(range(8)))
+    hv = host_verdicts(vs)
+    h = batch.health_for(0)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never")
+    assert verdicts == hv == [False] * 8
+    stats = batch.last_run_stats
+    assert stats["device_rejects_confirmed"] == 8
+    assert stats["device_batches"] == 0
+    # the correctly-rejecting device stays admitted for the next call
+    assert h.device_allowed()
+    assert h.unresolved_probe_streak == 0
+
+
+# -- fault class: mid-flight lane death -----------------------------------
+
+
+def test_lane_death_mid_flight_fails_over_to_host():
+    """The worker thread dies inside a device call (LaneDeathSignal):
+    the in-flight chunk never resolves, the deadline machinery fails the
+    batches over to the host, the dead lane is abandoned, and a fresh
+    get() builds a working replacement.  Verdicts identical to the
+    pure-host path."""
+    mark_shapes_warm()
+    h = health.DeviceHealth(clock=health.FakeClock())
+    plan = faults.FaultPlan([faults.KillLane(on=0, advance=3600.0)])
+    vs = make_verifiers(4, bad={0})
+    hv = host_verdicts(vs)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                     merge="never", health=h)
+    assert verdicts == hv
+    stats = batch.last_run_stats
+    assert stats["device_sick"] and stats["host_batches"] == 4
+    assert batch._DeviceLane._instances.get(0) is None
+    # the replacement lane is alive and serves a healthy follow-up call
+    h2 = health.DeviceHealth(clock=health.FakeClock())
+    lane = batch._DeviceLane.get(mesh=0, health=h2)
+    assert lane.healthy()
+
+
+# -- sharded (virtual-mesh) injection -------------------------------------
+
+
+def test_sharded_allreduce_injection_matches_host():
+    """Fault injected at the SHARDED dispatch boundary (the mesh
+    all-reduce seam in parallel/sharded_msm.py), on the virtual 8-device
+    CPU mesh: every batch re-decided on the host, verdicts identical."""
+    mark_shapes_warm(mesh=2)
+    vs = make_verifiers(8, bad={2})
+    hv = host_verdicts(vs)
+    plan = faults.FaultPlan(
+        [faults.ErrorOn(on=every_call, site=faults.SITE_SHARDED)])
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                     merge="never", mesh=2)
+    assert verdicts == hv
+    stats = batch.last_run_stats
+    assert stats["device_batches"] == 0
+    assert stats["host_batches"] == 8
+    assert stats["device_errors"] >= 1
+    assert plan.calls_seen(faults.SITE_SHARDED) >= 1
+    assert ("sharded", 0, "ErrorOn") in plan.injection_log()
+
+
+# -- the whole ladder: union merge + bisection under faults ---------------
+
+
+def test_full_ladder_union_bisection_under_device_errors():
+    """merge="always" + a dead device: unions fall back to the host, bad
+    batches are isolated by bisection (the per-item rung of the ladder),
+    and the per-batch verdicts still match the pure-host ground truth."""
+    mark_shapes_warm()
+    bad = {3, 11}
+    vs = make_verifiers(16, sigs_per_batch=2, bad=bad)
+    hv = host_verdicts(vs)
+    plan = faults.FaultPlan([faults.ErrorOn(on=every_call)])
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, merge="always")
+    assert verdicts == hv == [i not in bad for i in range(16)]
+
+
+# -- plan determinism ------------------------------------------------------
+
+
+def test_fault_plans_are_deterministic():
+    """Two plans with the same seed inject identically: same schedule,
+    same corruption bits, same injection log over the same call
+    stream."""
+    p1 = faults.randomized_plan(7, error_rate=0.3, corrupt_rate=0.3)
+    p2 = faults.randomized_plan(7, error_rate=0.3, corrupt_rate=0.3)
+    assert p1.schedule(faults.SITE_LANE, 128) == \
+        p2.schedule(faults.SITE_LANE, 128)
+    assert p1.schedule(faults.SITE_LANE, 128) != \
+        faults.randomized_plan(8, error_rate=0.3,
+                               corrupt_rate=0.3).schedule(
+            faults.SITE_LANE, 128)
+
+    def drive(plan):
+        outs = []
+        for _ in range(64):
+            try:
+                outs.append(plan.run(faults.SITE_LANE,
+                                     lambda: np.arange(24, dtype=np.int32)
+                                     .reshape(2, 12)).tolist())
+            except faults.InjectedFault:
+                outs.append("error")
+        return outs, plan.injection_log()
+
+    o1, log1 = drive(p1)
+    o2, log2 = drive(p2)
+    assert o1 == o2 and log1 == log2
+    assert "error" in o1  # at rate 0.3 over 64 calls the seed fires
+    assert any(isinstance(o, list) and o != np.arange(24, dtype=np.int32)
+               .reshape(2, 12).tolist() for o in o1)  # corruption fired
+
+
+def test_stall_fault_advances_virtual_clock_only():
+    """StallFor on a virtual clock advances it instead of sleeping; on
+    the real clock the scheduler is never handed a virtual-only API."""
+    clk = health.FakeClock()
+    plan = faults.FaultPlan([faults.StallFor(2.5, on=0)])
+    t0 = clk.monotonic()
+    out = plan.run(faults.SITE_LANE, lambda: "ok", clock=clk)
+    assert out == "ok"
+    assert clk.monotonic() - t0 == 2.5
+
+
+def test_seam_is_transparent_without_a_plan():
+    assert faults.active_plan() is None
+    assert faults.run_device_call(faults.SITE_LANE, lambda: 41) == 41
+    with faults.injected(faults.FaultPlan([faults.ErrorOn(on=0)])) as p:
+        assert faults.active_plan() is p
+        with pytest.raises(faults.InjectedFault):
+            faults.run_device_call(faults.SITE_LANE, lambda: 41)
+        # a second install while one is active is a caller bug
+        with pytest.raises(RuntimeError):
+            faults.install(faults.FaultPlan([]))
+    assert faults.active_plan() is None
+
+
+def test_lane_death_signal_is_not_an_error_result():
+    """The lane worker must treat LaneDeathSignal as thread death (no
+    result, lane unhealthy), NOT as a clean error result — otherwise
+    'lane death' would silently degrade into the error fault class."""
+    mark_shapes_warm()
+    h = health.DeviceHealth(clock=health.FakeClock())
+    plan = faults.FaultPlan([faults.KillLane(on=0, advance=0.0)])
+    lane = batch._DeviceLane.get(mesh=0, health=h)
+    d = np.zeros((1, 33, 8), dtype=np.int8)
+    p = np.zeros((1, 4, 20, 8), dtype=np.int16)
+    with faults.injected(plan):
+        cid = lane.submit(d, p)
+        deadline = threading.Event()
+        for _ in range(500):
+            if not lane._thread.is_alive():
+                break
+            deadline.wait(0.01)
+    assert not lane._thread.is_alive()
+    assert not lane.healthy()
+    assert lane.wait(cid, 0.0) is batch._PENDING  # no result was reported

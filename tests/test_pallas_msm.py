@@ -10,6 +10,7 @@ every run)."""
 import random
 
 import numpy as np
+import pytest
 
 from ed25519_consensus_tpu.ops import limbs
 
@@ -91,32 +92,23 @@ def test_point_packing_int16_from_raw():
     assert np.array_equal(packed.astype(np.int32), want)
 
 
-def test_multiblock_interpret_kernel_parity():
-    """Run the ACTUAL Pallas kernel in interpret mode across MULTIPLE grid
-    blocks and pin it against the exact host MSM — covers the in-kernel
-    table build, signed-digit select, cross-block fold, and
-    block-boundary/identity padding, for small AND full-width (128-bit)
-    digit planes.
-
-    Infrastructure note: interpret=True lowers to plain XLA ops.  The
-    rolled kernel body traces/compiles in ~1 min even on the true cpu
-    backend, so cpu-only hosts get real coverage; the hybrid
-    (unrolled-windows) body is additionally pinned when an accelerator
-    is attached (remote compile ~1-2 min).  Runs in a clean subprocess
-    so the backend choice can differ from the suite's forced-cpu
-    config."""
+def _run_interp_parity_case(mode=None):
+    """Run tools/interp_parity_case.py in a clean subprocess (so the
+    backend choice can differ from the suite's forced-cpu config) and
+    assert every printed case MATCHes."""
     import os
     import subprocess
     import sys
 
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    proc = subprocess.run(
-        [sys.executable,
-         os.path.join(os.path.dirname(__file__), "..", "tools",
-                      "interp_parity_case.py")],
-        capture_output=True, text=True, timeout=900, env=env,
-    )
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "interp_parity_case.py")]
+    if mode:
+        cmd.append(mode)
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=900, env=env)
     out = proc.stdout + proc.stderr
     assert "INTERP_PARITY" in out, out[-2000:]
     if "SKIP" in out:
@@ -126,3 +118,32 @@ def test_multiblock_interpret_kernel_parity():
                     "10-25 min on the true cpu backend; Mosaic parity is "
                     "covered by tools/check_pallas_parity.py")
     assert "MATCH" in out and "MISMATCH" not in out, out[-2000:]
+
+
+def test_multiblock_interpret_kernel_parity():
+    """Run the ACTUAL Pallas kernel in interpret mode across MULTIPLE grid
+    blocks and pin it against the exact host MSM — covers the in-kernel
+    table build, signed-digit select, cross-block fold, and
+    block-boundary/identity padding, for small AND full-width (128-bit)
+    digit planes, with the full eight-torsion (small-order) point set
+    riding the batch.
+
+    Infrastructure note: interpret=True lowers to plain XLA ops.  The
+    rolled kernel body traces/compiles in ~1 min even on the true cpu
+    backend, so cpu-only hosts get real coverage; the hybrid
+    (unrolled-windows) body is additionally pinned when an accelerator
+    is attached (remote compile ~1-2 min)."""
+    _run_interp_parity_case()
+
+
+@pytest.mark.slow
+def test_selectable_kernel_variants_interpret_parity():
+    """VERDICT r5 #4: every SELECTABLE kernel variant — body=hybrid
+    (ED25519_TPU_PALLAS_BODY), tbl_dtype=int32 (the G=2048 VMEM-overflow
+    escape), and a non-default win_chunk (ED25519_TPU_WIN_CHUNK) — is
+    pinned against the exact host MSM on the same small-order +
+    adversarial-digit case, so no env knob can silently diverge from the
+    ZIP215 matrix.  Each variant is its own kernel compile (~1 min each
+    on the true cpu backend), hence the `slow` mark: CI's full pytest
+    run includes it; the tier-1 quick run (-m 'not slow') skips it."""
+    _run_interp_parity_case("variants")

@@ -8,6 +8,14 @@ change a verdict.  These tests drive every branch of that machinery by
 monkeypatching the device dispatch function — verdicts are always decided
 by the same exact host math, so each test asserts both the scheduling
 behavior (stats/cooldowns) and verdict correctness.
+
+Since round 6 the health state lives in per-mesh health.DeviceHealth
+objects with an injectable clock, and every timing-SENSITIVE test here
+(deadline misses, compile grace, probe grace) drives the scheduler on a
+health.FakeClock: the scenario advances virtual time explicitly, so the
+assertions are load-independent — no wall-time bounds anywhere in this
+file.  (Fault-CLASS coverage — error/stall/flap/corrupt/lane-death via
+the faults.py seam — lives in tests/test_faults.py.)
 """
 
 import random
@@ -16,7 +24,7 @@ import time
 
 import pytest
 
-from ed25519_consensus_tpu import SigningKey, batch
+from ed25519_consensus_tpu import SigningKey, batch, health
 from ed25519_consensus_tpu.ops import msm
 
 rng = random.Random(0x5C4ED)
@@ -24,12 +32,20 @@ rng = random.Random(0x5C4ED)
 
 @pytest.fixture(autouse=True)
 def reset_device_state():
-    """Reset the module-level scheduler state (cooldowns, lane singleton)
-    so tests are order-independent."""
+    """Reset the per-mesh scheduler health state (cooldowns, lane
+    registry, the process lane-stuck latch) so tests are
+    order-independent."""
     yield
     batch._DeviceLane.reset_all()
     batch.reset_device_health()
     batch.last_run_stats.clear()
+
+
+def fake_health(mesh: int = 0) -> health.DeviceHealth:
+    """An isolated DeviceHealth on a FakeClock: scheduling time (EMA,
+    deadlines, grace, cooldowns) advances only when the test's injected
+    dispatch advances it — host load cannot move any deadline."""
+    return health.DeviceHealth(mesh=mesh, clock=health.FakeClock())
 
 
 def make_verifiers(n_batches, sigs_per_batch=3, bad=()):
@@ -131,13 +147,20 @@ def test_error_chunk_benches_device_for_the_call(monkeypatch):
 def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     """A stalled device call (tunnel seizure) must miss its deadline, mark
     the device sick, re-verify its batches on the host, abandon the lane,
-    and start the cooldown.  Warmed first: an UNWARMED shape's first call
-    legitimately gets the compile grace budget instead (see
-    test_unwarmed_first_call_gets_compile_grace)."""
+    and start the cooldown.  FAKE CLOCK: the stall advances virtual time
+    past any deadline, so the miss is deterministic and instant — no
+    2-second real wait, no load sensitivity.  Warmed first: an UNWARMED
+    shape's first call legitimately gets the compile grace budget instead
+    (see test_unwarmed_first_call_gets_compile_grace)."""
     warm_kernel_cache()
+    h = fake_health()
     release = threading.Event()
 
     def stall(digits, pts):
+        # the tunnel seizes: (virtual) time passes far beyond the
+        # deadline AND the 600 s compile-grace budget, and the call
+        # never completes until the process has given up on it
+        h.clock.advance(1000.0)
         release.wait(timeout=30.0)
         raise RuntimeError("stalled call never completes")
 
@@ -146,10 +169,10 @@ def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     # hybrid on, the host overtakes a stalled probe long before the
     # deadline — by design), so the blocking poll hits the deadline.
     vs = make_verifiers(5, bad={0})
-    t0 = time.monotonic()
+    t0 = h.now()
     try:
         verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
-                                    merge="never")
+                                     merge="never", health=h)
     finally:
         release.set()  # let the abandoned worker die promptly
     assert verdicts == expected(5, bad={0})
@@ -158,7 +181,9 @@ def test_deadline_miss_abandons_lane_and_sets_cooldown(monkeypatch):
     assert stats["device_batches"] == 0
     assert stats["host_batches"] == 5
     assert batch.device_lane_stuck()
-    assert batch._device_cooldown_until[0] > t0  # cooldown armed
+    assert h.lane_stuck
+    assert h.cooldown_until > t0  # cooldown armed
+    assert not h.device_allowed()
     # the sick lane was abandoned: a fresh get() builds a new one
     assert batch._DeviceLane._instances.get(0) is None
 
@@ -167,53 +192,65 @@ def test_unwarmed_first_call_gets_compile_grace(monkeypatch):
     """hybrid=False with an UNWARMED shape: the first device call may be
     sitting in a minutes-long kernel compile, so a call that merely
     exceeds the normal ~2 s turnaround deadline must NOT mark the device
-    sick / stick the lane (round-2 advisor finding).  Seizure detection
-    for warmed shapes is test_deadline_miss_abandons_lane_and_sets_cooldown."""
+    sick / stick the lane (round-2 advisor finding).  FAKE CLOCK: the
+    slow call advances virtual time past the 2 s deadline floor but
+    inside the 600 s compile-grace budget — the round-4/round-5 wall-time
+    bound (and its contended-run flake history) is gone; the
+    grace-hybrid behavior is asserted directly on the lane split
+    instead.  Seizure detection for warmed shapes is
+    test_deadline_miss_abandons_lane_and_sets_cooldown."""
     warm_kernel_cache()  # compile the real kernel so verdict math is fast
     monkeypatch.setattr(msm, "_shapes_completed", set())  # …but look cold
+    h = fake_health()
     real_dispatch = msm.dispatch_window_sums_many
     calls = []
 
     def slow_first_call(digits, pts):
         calls.append(digits.shape[0])
-        time.sleep(3.0)  # longer than the normal 2 s deadline floor
+        h.clock.advance(3.0)  # longer than the normal 2 s deadline floor
         return real_dispatch(digits, pts)
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", slow_first_call)
     vs = make_verifiers(3, bad={1})
-    t0 = time.monotonic()
+    t0 = h.now()
     verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
-                                 merge="never")
+                                 merge="never", health=h)
     assert verdicts == expected(3, bad={1})
     stats = batch.last_run_stats
     assert len(calls) >= 1  # the device was actually exercised
     # slow-but-compiling is NOT sick: no cooldown, lane kept
     assert not stats["device_sick"]
     assert not batch.device_lane_stuck()
-    assert batch._device_cooldown_until[0] <= t0
+    assert h.cooldown_until <= t0
     # …and the grace window doesn't park the caller behind the slow
-    # call: the host lane covers the pool meanwhile (grace-hybrid), so
-    # total wall stays ~one slow call, not batches × slow calls.  The
-    # pathology this guards against is each chunk parking for the 600 s
-    # unwarmed-shape grace budget (batch.py poll()), so the bound only
-    # needs to sit far below ONE grace window while tolerating heavy
-    # co-tenant load on this 1-core node (a second full suite slowed the
-    # clean-core ~6 s wall past the old 20 s bound — round-4 flake).
-    assert time.monotonic() - t0 < 90.0
+    # call: the pathology this guards against is each chunk parking for
+    # the 600 s unwarmed-shape grace budget (batch.py poll()) — which on
+    # the fake clock would show up as virtual time jumping by grace
+    # windows.  It must not: only the injected 3 s advances happened.
+    assert h.now() - t0 <= 3.0 * len(calls)
+    # every batch was decided exactly once, host and device lanes adding
+    assert stats["host_batches"] + stats["device_batches"] == 3
 
 
 def test_cooldown_skips_device_entirely(monkeypatch):
     """While the health cooldown is armed, verify_many must not touch the
-    device lane at all."""
-    batch._device_cooldown_until[0] = time.monotonic() + 60.0
+    device lane at all.  The cooldown is armed through the DeviceHealth
+    transition itself (fake clock — no wall time involved)."""
+    h = fake_health()
+    h.note_deadline_miss()  # arms DEADLINE_COOLDOWN from virtual now
+    assert not h.device_allowed()
 
-    def fail_get(cls):
+    def fail_get(cls, mesh=0, health=None):
         raise AssertionError("device lane used during cooldown")
 
     monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
     vs = make_verifiers(4, bad={3})
-    assert batch.verify_many(vs, rng=rng, merge="never") == expected(4, bad={3})
+    assert batch.verify_many(vs, rng=rng, merge="never",
+                             health=h) == expected(4, bad={3})
     assert batch.last_run_stats["host_batches"] == 4
+    # …and once virtual time passes the cooldown, the device is allowed
+    h.clock.advance(h.DEADLINE_COOLDOWN + 1.0)
+    assert h.device_allowed()
 
 
 def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
@@ -232,8 +269,9 @@ def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
         return real_dispatch(digits, pts)
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", slow)
+    h = batch.health_for(0)
     t0 = time.monotonic()
-    for _ in range(batch._UNRESOLVED_PROBE_LIMIT):
+    for _ in range(h.UNRESOLVED_PROBE_LIMIT):
         vs = make_verifiers(10, bad={1})
         verdicts = batch.verify_many(vs, rng=rng, chunk=2, merge="never")
         assert verdicts == expected(10, bad={1})
@@ -241,12 +279,13 @@ def test_uncompetitive_pause_after_zero_device_wins(monkeypatch):
         assert not stats["device_sick"]
         # the host (ms per batch) always overtakes a 0.75 s device probe
         assert stats["device_batches"] == 0
-        if batch._device_uncompetitive_until[0] > t0:
+        if h.uncompetitive_until > t0:
             break
-    assert batch._device_uncompetitive_until[0] > t0
+    assert h.uncompetitive_until > t0
+    assert not h.device_allowed()
     # next call: pure host, no lane contact
 
-    def fail_get(cls):
+    def fail_get(cls, mesh=0, health=None):
         raise AssertionError("probed during uncompetitive pause")
 
     monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
@@ -268,21 +307,23 @@ def test_unresolved_probe_streak_arms_backoff(monkeypatch):
         raise RuntimeError("probe never yields a measurement")
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", boom)
+    h = batch.health_for(0)
     t0 = time.monotonic()
-    for i in range(batch._UNRESOLVED_PROBE_LIMIT):
+    for i in range(h.UNRESOLVED_PROBE_LIMIT):
         vs = make_verifiers(8, bad={1})
         assert batch.verify_many(vs, rng=rng, chunk=2,
                                  merge="never") == expected(8, bad={1})
         stats = batch.last_run_stats
         assert stats["probed"] and not stats["device_measured"]
         assert stats["host_batches"] == 8
-        assert batch._unresolved_probe_streak[0] == i + 1
+        assert stats["device_errors"] >= 1  # the fault counter saw it
+        assert h.unresolved_probe_streak == i + 1
     # limit reached: the shorter backoff is armed…
-    assert batch._device_uncompetitive_until[0] > t0
+    assert h.uncompetitive_until > t0
     # …and the next call must not touch the device lane at all
     n_probes = len(calls)
 
-    def fail_get(cls, mesh=0):
+    def fail_get(cls, mesh=0, health=None):
         raise AssertionError("probed during unresolved-probe backoff")
 
     monkeypatch.setattr(batch._DeviceLane, "get", classmethod(fail_get))
@@ -293,31 +334,29 @@ def test_unresolved_probe_streak_arms_backoff(monkeypatch):
     assert not batch.last_run_stats["probed"]
     # reset_device_health clears the streak with the rest of the state
     batch.reset_device_health()
-    assert batch._unresolved_probe_streak[0] == 0
+    assert h.unresolved_probe_streak == 0
 
 
-def test_measured_probe_resets_unresolved_streak(monkeypatch):
+def test_measured_probe_resets_unresolved_streak():
     """A probe that DOES resolve (measured EMA) must clear the unresolved
     streak — only consecutive unresolved probes arm the backoff.
 
-    The young-probe grace is raised for the assertion to hold under
-    co-tenant load: this test REQUIRES the probe to resolve, and on the
-    forced-cpu suite a second full suite on the same core can stretch
-    the warm virtual-kernel call past the production 3 s grace (the
-    round-5 tally's one contended failure)."""
+    FAKE CLOCK: this test REQUIRES the probe to resolve.  On the
+    round-5 wall clock that meant raising the young-probe grace to 60 s
+    so co-tenant load could not stretch the warm virtual-kernel call
+    past it (the round-5 tally's one contended failure).  On the fake
+    clock the probe's virtual age stays 0 < grace no matter how loaded
+    the host is — the grace wait simply lasts until the real kernel
+    call delivers — so the production grace needs no override at all."""
     warm_kernel_cache()
-    old_grace = batch._young_probe_grace[0]
-    batch._young_probe_grace[0] = 60.0
-    try:
-        batch._unresolved_probe_streak[0] = batch._UNRESOLVED_PROBE_LIMIT - 1
-        vs = make_verifiers(4)
-        assert batch.verify_many(vs, rng=rng, chunk=2,
-                                 merge="never") == expected(4)
-        assert batch.last_run_stats["device_measured"] or \
-            batch.last_run_stats["device_batches"]
-        assert batch._unresolved_probe_streak[0] == 0
-    finally:
-        batch._young_probe_grace[0] = old_grace
+    h = fake_health()
+    h.unresolved_probe_streak = h.UNRESOLVED_PROBE_LIMIT - 1
+    vs = make_verifiers(4)
+    assert batch.verify_many(vs, rng=rng, chunk=2,
+                             merge="never", health=h) == expected(4)
+    assert batch.last_run_stats["device_measured"] or \
+        batch.last_run_stats["device_batches"]
+    assert h.unresolved_probe_streak == 0
 
 
 def test_host_overtake_discards_inflight_chunk(monkeypatch):
@@ -427,7 +466,7 @@ def test_mesh_error_chunk_falls_back_to_host(monkeypatch):
     warm_mesh_shapes()
     calls = []
 
-    def boom(digits, pts, n_devices):
+    def boom(digits, pts, n_devices, clock=None):
         calls.append((digits.shape[0], n_devices))
         raise RuntimeError("injected mesh error")
 
@@ -446,29 +485,34 @@ def test_mesh_error_chunk_falls_back_to_host(monkeypatch):
 def test_mesh_deadline_miss_abandons_mesh_lane(monkeypatch):
     """A stalled mesh call past the (warmed-shape) deadline → device
     sick, batches re-verified on host, the MESH-mode lane abandoned and
-    the cooldown armed — without touching the single-device lane
-    registry slot."""
+    the cooldown armed on the MESH health — without touching the
+    single-device lane registry slot or the mesh-0 health.  FAKE CLOCK:
+    the stall advances virtual time, so the miss is deterministic."""
     from ed25519_consensus_tpu.parallel import sharded_msm
 
     warm_mesh_shapes()
+    h = fake_health(mesh=MESH)
     release = threading.Event()
 
-    def stall(digits, pts, n_devices):
+    def stall(digits, pts, n_devices, clock=None):
+        h.clock.advance(1000.0)
         release.wait(timeout=30.0)
         raise RuntimeError("stalled mesh call")
 
     monkeypatch.setattr(sharded_msm, "sharded_window_sums_many", stall)
     vs = make_verifiers(4, bad={1})
-    t0 = time.monotonic()
+    t0 = h.now()
     try:
         verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
-                                     merge="never", mesh=MESH)
+                                     merge="never", mesh=MESH, health=h)
     finally:
         release.set()
     assert verdicts == expected(4, bad={1})
     stats = batch.last_run_stats
     assert stats["device_sick"] and stats["host_batches"] == 4
-    assert batch._device_cooldown_until[0] > t0
+    assert h.cooldown_until > t0
+    # per-mesh isolation: the single-device health is untouched
+    assert batch.health_for(0).cooldown_until == 0.0
     assert batch._DeviceLane._instances.get(MESH) is None
 
 
@@ -480,7 +524,7 @@ def test_mesh_probe_discard_on_host_overtake(monkeypatch):
     warm_mesh_shapes()
     release = threading.Event()
 
-    def gated(digits, pts, n_devices):
+    def gated(digits, pts, n_devices, clock=None):
         release.wait(timeout=30.0)
         raise RuntimeError("gated mesh call never completes")
 

@@ -1,0 +1,179 @@
+"""Chaos soak: long-running randomized fault schedules against the
+verify_many scheduler, asserting on every round that verdicts are
+bit-identical to the pure-host path no matter what the (injected) device
+does.
+
+Each round draws a fresh deterministic FaultPlan from the master seed
+(faults.randomized_plan — error / stall / corrupted-sum faults plus an
+optional flapping link), builds a mixed valid/tampered batch pool, runs
+verify_many under the plan, and compares against the exact host ground
+truth.  Any mismatch prints the round's replay seed and exits nonzero —
+`python tools/chaos_soak.py --seed N --rounds 1` reproduces a failing
+round exactly (plans are pure functions of the seed and call stream).
+
+Usage:
+  python tools/chaos_soak.py [--seed 0xC4A05] [--rounds 50]
+      [--batches 12] [--mesh 0] [--flap 0] [--json]
+
+Runs on any backend (CI uses the virtual 8-device CPU mesh); the fault
+seam sits above the kernel, so the same schedule drives a real TPU lane
+unchanged."""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu import SigningKey, batch, faults  # noqa: E402
+from ed25519_consensus_tpu.utils import metrics  # noqa: E402
+
+
+def make_pool(rnd, keys, n_batches, sigs):
+    """Mixed valid/tampered batches.  One FIXED batch size per soak: the
+    scheduler pads every chunk to one (chunk, lanes) shape, so a single
+    up-front warm covers the whole run and the device lane actually
+    participates from round 1 (with per-round random sizes, each new
+    chunk shape would sit in a virtual-kernel compile while the host
+    lane — correctly — drained the pool, and the soak would never
+    exercise the device rungs of the ladder)."""
+    vs, want = [], []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        bad_at = rnd.randrange(sigs) if rnd.random() < 0.35 else -1
+        for j in range(sigs):
+            sk = rnd.choice(keys)
+            m = b"chaos %d %d" % (b, j)
+            sig = sk.sign(m)
+            if j == bad_at:
+                m += b"!"  # tamper
+            v.queue((sk.verification_key_bytes(), sig, m))
+        vs.append(v)
+        want.append(bad_at < 0)
+    return vs, want
+
+
+def warm_shapes(example, chunk: int, mesh: int) -> None:
+    """Compile + mark the scheduler's padded chunk shape for the chosen
+    dispatch mode.  batch.warm_device_shapes covers the single-device
+    lane; the mesh lane needs the sharded kernel at its shard padding
+    (mirrors tests' warm_mesh_shapes + the lane worker's
+    mark_shape_completed), or every chunk would sit in the compile-grace
+    window and the soak would never exercise the device rungs."""
+    if not mesh or mesh <= 1:
+        batch.warm_device_shapes(example, chunk=chunk)
+        return
+    import numpy as np
+
+    from ed25519_consensus_tpu.ops import msm
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    try:
+        staged = example._stage(None)
+        pad = sharded_msm.shard_pad(staged.n_device_terms, mesh)
+        d, p = staged.device_operands(lambda n: pad)
+        dd = np.stack([d] * chunk)
+        pp = np.stack([p] * chunk)
+        with msm.DEVICE_CALL_LOCK:
+            np.asarray(sharded_msm.sharded_window_sums_many(dd, pp, mesh))
+        msm.mark_shape_completed(chunk, pad, mesh)
+    except Exception:
+        return  # warming is an optimization; the soak still runs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=lambda s: int(s, 0), default=0xC4A05)
+    ap.add_argument("--rounds", type=int, default=50)
+    ap.add_argument("--batches", type=int, default=12)
+    ap.add_argument("--sigs", type=int, default=4,
+                    help="signatures per batch (fixed — see make_pool)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard over an N-device mesh (0 = single device)")
+    ap.add_argument("--flap", type=int, default=0,
+                    help="flapping-link period (0 = no flap fault)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per round instead of text")
+    args = ap.parse_args(argv)
+
+    rnd = random.Random(args.seed)
+    keys = [SigningKey.new(rnd) for _ in range(16)]
+    site = faults.SITE_SHARDED if args.mesh and args.mesh > 1 \
+        else faults.SITE_LANE
+    # Warm the scheduler's chunk shapes once, outside the chaos (like a
+    # production service would): the soak forces the device lane
+    # (hybrid=False) so faults actually land on device-processed chunks
+    # instead of the host racing every probe away.
+    warm_vs, _ = make_pool(random.Random(args.seed ^ 0xA), keys,
+                           args.batches, args.sigs)
+    warm_shapes(warm_vs[0], chunk=8, mesh=args.mesh)
+    mismatches = 0
+    t_begin = time.time()
+    totals = {"rounds": 0, "batches": 0, "injected": 0,
+              "device_batches": 0, "host_batches": 0, "sick_rounds": 0}
+    for r in range(args.rounds):
+        round_seed = rnd.getrandbits(32)
+        plan = faults.randomized_plan(
+            round_seed, error_rate=0.15, stall_rate=0.05,
+            stall_seconds=0.05, corrupt_rate=0.10,
+            flap_period=args.flap, site=site)
+        vs, want = make_pool(random.Random(round_seed ^ 0x5EED),
+                             keys, args.batches, args.sigs)
+        vrng = random.Random(round_seed ^ 0xB11D)
+        batch.reset_device_health()  # every round gets a live device lane
+        with faults.injected(plan):
+            got = batch.verify_many([v.clone() for v in vs], rng=vrng,
+                                    hybrid=False, merge="never",
+                                    mesh=args.mesh or None)
+        host = [batch._host_verdict(v, vrng) for v in vs]
+        ok = got == host == want
+        s = dict(batch.last_run_stats)
+        rec = {
+            "round": r, "seed": round_seed, "ok": ok,
+            "injected": len(plan.injection_log()),
+            "device_batches": s.get("device_batches", 0),
+            "host_batches": s.get("host_batches", 0),
+            "device_errors": s.get("device_errors", 0),
+            "rejects_confirmed": s.get("device_rejects_confirmed", 0),
+            "rejects_overturned": s.get("device_rejects_overturned", 0),
+            "sick": s.get("device_sick", False),
+        }
+        totals["rounds"] += 1
+        totals["batches"] += len(vs)
+        totals["device_calls"] = totals.get("device_calls", 0) + \
+            plan.calls_seen(site)
+        totals["injected"] += rec["injected"]
+        totals["device_batches"] += rec["device_batches"]
+        totals["host_batches"] += rec["host_batches"]
+        totals["sick_rounds"] += bool(rec["sick"])
+        if args.json:
+            print(json.dumps(rec))
+        elif not ok or rec["injected"]:
+            print(f"round {r:3d} seed={round_seed:#010x} "
+                  f"inj={rec['injected']:2d} dev={rec['device_batches']:2d} "
+                  f"host={rec['host_batches']:2d} sick={rec['sick']} "
+                  f"{'OK' if ok else 'MISMATCH'}")
+        if not ok:
+            mismatches += 1
+            bad = [i for i, (g, h) in enumerate(zip(got, host)) if g != h]
+            print(f"MISMATCH round={r} seed={round_seed:#x} batches={bad} "
+                  f"got={got} host={host} want={want}", file=sys.stderr)
+    dt = time.time() - t_begin
+    summary = {
+        "ok": mismatches == 0, "mismatches": mismatches,
+        "seconds": round(dt, 2),
+        "fault_counters": metrics.fault_counters(), **totals,
+    }
+    print("CHAOS_SOAK", json.dumps(summary))
+    sys.stdout.flush()  # os._exit skips buffer flushing (piped CI logs)
+    # lane workers may still hold discarded chunks; exit like bench.py
+    # does rather than risk native teardown with a parked worker
+    batch._DeviceLane.reset_all(timeout=30.0)
+    os._exit(0 if mismatches == 0 else 1)
+
+
+if __name__ == "__main__":
+    main()

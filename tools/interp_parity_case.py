@@ -28,6 +28,10 @@ from ed25519_consensus_tpu.ops import edwards, limbs, msm, pallas_msm  # noqa: E
 def main():
     import jax
 
+    # mode: default pins the baseline bodies over small/wide/packed-dwire;
+    # `variants` pins the selectable env-knob kernel variants instead
+    # (each its own compile — the slow-marked test in test_pallas_msm.py)
+    mode = sys.argv[1] if len(sys.argv) > 1 else "baseline"
     backend = jax.devices()[0].platform
     # Which kernel bodies to pin: the rolled body's interpret graph
     # compiles in ~1 min even on the true cpu backend, so cpu-only hosts
@@ -35,13 +39,18 @@ def main():
     # (unrolled-windows) body.  The legacy list-of-tiles body was
     # removed in round 4 (could no longer compile at production shape).
     bodies = ("rolled",) if backend == "cpu" else ("rolled", "hybrid")
+    if mode == "variants":
+        bodies = ()
     rng = random.Random(0x1417)
     tile = (1, 128)
     group = tile[0] * tile[1]
-    n = group + 5  # 2 grid blocks + identity padding in the last
+    n = group + 9  # 2 grid blocks + identity padding in the last
+    # ZIP215/196-matrix subset: ALL eight torsion points ride the batch
+    # (the small-order encodings behind the reference's 196-case matrix,
+    # tests/test_small_order.py), alongside ordinary prime-order points.
     tors = edwards.eight_torsion()
     pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, 10_000))
-           for _ in range(n - 4)] + tors[1:5]
+           for _ in range(n - 8)] + list(tors)
     sc = [rng.randrange(16) for _ in range(n)]
     sc[0] = 0          # identity contribution
     sc[1] = 1
@@ -83,6 +92,30 @@ def main():
             verdicts.append(
                 f"{body}/{label}:"
                 f"{'MATCH' if got == want_pt else 'MISMATCH'}"
+            )
+    # Selectable kernel-variant pins (VERDICT r5 #4): every env knob that
+    # changes the compiled kernel — body style, table dtype, windows per
+    # grid step — gets its own conformance case against the same matrix,
+    # so no ED25519_TPU_* setting can silently diverge from ZIP215.
+    # Pinned on the small case (2 digit planes) on EVERY backend — each
+    # variant is its own compile, so the set runs as a separate
+    # `variants` invocation (a slow-marked test in test_pallas_msm.py;
+    # the tier-1 quick run keeps the baseline cases only).
+    if mode == "variants":
+        for label, kwargs in (
+            ("variant-hybrid", dict(body="hybrid")),
+            ("variant-tbl-int32", dict(tbl_dtype="int32")),
+            ("variant-win-chunk2", dict(win_chunk=2)),
+        ):
+            out = np.asarray(
+                pallas_msm.pallas_window_sums_many(
+                    digits[None], packed[None], interpret=True, tile=tile,
+                    **kwargs,
+                )
+            )
+            got = msm.combine_window_sums(out)
+            verdicts.append(
+                f"{label}:{'MATCH' if got == want else 'MISMATCH'}"
             )
     verdict = " ".join(verdicts)
     print(f"INTERP_PARITY {backend} {verdict}")
