@@ -519,8 +519,11 @@ def main():
             note = (f" (FAILED: {type(res).__name__}: {str(res)[:120]})")
         print(f"# warm_device_shapes({warm_bv.batch_size} sigs): "
               f"{time.time()-t0:.1f}s{note}", file=sys.stderr)
+        # mesh=0 pins the single-device lane: these configs measure the
+        # per-chip number, which auto-routing (routing.py) would shard
+        # above the N* crossover on a multi-device backend.
         batch_mod.verify_many(
-            [rebuild_fresh(bv) for _ in range(depth)], rng=rng
+            [rebuild_fresh(bv) for _ in range(depth)], rng=rng, mesh=0
         )
         s = batch_mod.last_run_stats
         print(f"# warm verify_many: device "
@@ -541,7 +544,8 @@ def main():
                 from ed25519_consensus_tpu import batch as batch_mod
 
                 verdicts = batch_mod.verify_many(
-                    [rebuild_fresh(bv) for _ in range(run_depth)], rng=rng
+                    [rebuild_fresh(bv) for _ in range(run_depth)],
+                    rng=rng, mesh=0  # per-chip measurement (see warm)
                 )
                 assert all(verdicts), "bench batch must verify"
                 s = batch_mod.last_run_stats
@@ -570,7 +574,7 @@ def main():
         t0 = time.time()
         verdicts = batch_mod.verify_many(
             [rebuild_fresh(bv) for _ in range(depth_)], rng=rng,
-            hybrid=False, merge="never",
+            hybrid=False, merge="never", mesh=0,  # per-chip measurement
         )
         dt = time.time() - t0
         s = dict(batch_mod.last_run_stats)

@@ -9,7 +9,7 @@ device meshes for large batches.
 
 Public surface mirrors reference src/lib.rs:6-16."""
 
-from . import batch, faults, health, serde
+from . import batch, faults, health, routing, serde, service
 from .error import (
     Error,
     InvalidSignature,
@@ -43,5 +43,7 @@ __all__ = [
     "batch",
     "faults",
     "health",
+    "routing",
     "serde",
+    "service",
 ]

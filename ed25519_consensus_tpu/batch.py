@@ -558,6 +558,9 @@ class Verifier:
         self._k_buf = bytearray()
         self._gid = _array.array("i")
         self._key_index = {}
+        # Explicit invalidation (see invalidate()): a reason string once
+        # the whole batch has been marked invalid out-of-band, else None.
+        self._invalid = None
 
     @property
     def signatures(self):
@@ -592,6 +595,33 @@ class Verifier:
             self._materialize()
         return self._sig_map
 
+    def invalidate(self, reason: str = "invalidated") -> None:
+        """Mark the WHOLE batch invalid, out-of-band: every subsequent
+        `verify`/`_stage` raises InvalidSignature, so the verdict under
+        `verify_many`/`verify_single_many` is False — exactly as if the
+        batch contained an unverifiable signature, but stated as intent
+        instead of manufactured as data.
+
+        This is THE supported way to force a False verdict for an entry
+        whose wire bytes never parsed into queueable objects (e.g. a
+        wrong-length signature in `verify_single_many`): before round 6
+        that path injected a crafted s ≥ ℓ poison signature by direct
+        `signatures`-map assignment — count-neutral map surgery in
+        exactly the style the exposure machinery exists to defend
+        against.  The flag is orthogonal to the queue contents: queued
+        entries, the coalescing map, and the fast-path buffers are
+        untouched (and remain mergeable); `clone()` copies the flag and
+        a union inherits it from any member (an invalid member makes
+        the union invalid — same all-or-nothing semantics as a poison
+        entry, resolved per-batch by the usual bisection)."""
+        self._invalid = str(reason)
+
+    @property
+    def invalid_reason(self) -> "str | None":
+        """The `invalidate()` reason, or None when the batch has not
+        been explicitly invalidated."""
+        return self._invalid
+
     @property
     def distinct_key_count(self) -> int:
         """Number of distinct verification keys queued, WITHOUT exposing
@@ -618,6 +648,7 @@ class Verifier:
         nv._k_buf = bytearray(self._k_buf)
         nv._gid = self._gid[:]
         nv._key_index = dict(self._key_index)
+        nv._invalid = self._invalid
         return nv
 
     def _materialize(self) -> None:
@@ -711,6 +742,10 @@ class Verifier:
         order-independent and every row stream is kept aligned), and the
         grouped walk is the fallback whenever the coalescing map was
         manipulated directly (`_buffers_live` size-consistency check)."""
+        if self._invalid is not None:
+            # Explicitly invalidated (invalidate()): unconditionally a
+            # staging rejection, before any other work.
+            raise InvalidSignature()
         if self._buffers_live():
             return self._stage_queue_order(rng)
         return self._stage_grouped(rng)
@@ -926,6 +961,11 @@ class Verifier:
         t_start = _time.perf_counter()
         metrics.backend = backend
         metrics.batch_size = self.batch_size
+        if self._invalid is not None:
+            # invalidate() contract: every verification path rejects —
+            # the fused native path below bypasses _stage, so the flag
+            # is enforced here too.
+            raise InvalidSignature()
         n = self.batch_size
         buffers_live = self._buffers_live()
         # key count without forcing map materialization on the fast path
@@ -1045,6 +1085,7 @@ class Verifier:
 # at the bottom of this file, as live views of the default-mesh health.
 from . import faults as _faults  # noqa: E402  (belongs with the lane)
 from . import health as _health  # noqa: E402
+from . import routing as _routing  # noqa: E402
 from .health import DeviceHealth  # noqa: E402,F401  (re-exported API)
 from .utils import metrics as _metrics  # noqa: E402
 
@@ -1462,6 +1503,13 @@ def merge_verifiers(group) -> "Verifier":
     the grouped fallback."""
     group = list(group)
     u = Verifier()
+    for v in group:
+        if v._invalid is not None:
+            # An explicitly-invalidated member makes the union invalid
+            # (all-or-nothing, like any unverifiable member signature);
+            # bisection pinpoints it per batch on the fallback path.
+            u._invalid = v._invalid
+            break
     buffers_ok = all(v._buffers_live() for v in group)
     if buffers_ok and all(not v._sig_map for v in group):
         # Fully-lazy members: the union inherits their pending entry
@@ -1540,7 +1588,9 @@ def _merge_groups(verifiers):
 def verify_many(verifiers, rng=None, chunk: int = 8,
                 hybrid: bool = True, merge: str = "auto",
                 mesh: int | None = None,
-                health: "DeviceHealth | None" = None) -> "list[bool]":
+                health: "DeviceHealth | None" = None,
+                policy: "_routing.RoutingPolicy | None" = None
+                ) -> "list[bool]":
     """Verify MANY independent batches with union-merging, chunked
     double-buffered device calls, and an opportunistic host lane.
 
@@ -1569,6 +1619,14 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     corrupted device result cannot fail a valid batch (see
     docs/failure-model.md for the full degradation ladder).
 
+    `mesh` routing (routing.py): `mesh=None` (the default) is AUTO —
+    the RoutingPolicy (`policy`, default routing.default_policy())
+    selects the full available mesh only when the estimated per-batch
+    term count clears the N* crossover AND that mesh's live health
+    allows the device; otherwise the single-device lane.  An explicit
+    `mesh=D` is a manual override that never consults the policy
+    (`mesh=0`/`mesh=1` forces the single-device lane).
+
     `health` injects the per-mesh DeviceHealth (cooldowns, probe
     backoff, young-probe grace) and its monotonic clock; default is the
     process health_for(mesh).  All scheduling time — deadlines, grace,
@@ -1578,14 +1636,6 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     import time as _time
 
     from .ops import msm
-
-    # mesh <= 1 is single-device dispatch: normalize EARLY so the lane,
-    # the health object, the shard padding, and the shape-completed
-    # grace keys all agree with the mesh=None path.
-    mesh = _health.normalize_mesh(mesh)
-    if health is None:
-        health = _health.health_for(mesh)
-    now = health.now
 
     verifiers = list(verifiers)
     if merge not in ("auto", "never", "always"):
@@ -1602,9 +1652,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             unions = [merge_verifiers([verifiers[i] for i in g])
                       for g in groups]
             t0 = _time.monotonic()
+            # `mesh` passes through UNRESOLVED: when it is None (auto),
+            # the recursive union-level call resolves routing on the
+            # MERGED batch sizes — the ones actually dispatched.
             union_verdicts = verify_many(
                 unions, rng=rng, chunk=chunk, hybrid=hybrid,
-                merge="never", mesh=mesh, health=health
+                merge="never", mesh=mesh, health=health, policy=policy
             )
             stats = dict(last_run_stats)
             verdicts = [False] * len(verifiers)
@@ -1630,12 +1683,31 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             last_run_stats.update(stats)
             return verdicts
 
+    if mesh is None:
+        # AUTO routing (routing.py; VERDICT r5 next-round #6): select
+        # the mesh lane only when the estimated per-batch term count of
+        # the LARGEST batch in this call clears the N* crossover on an
+        # available, currently-healthy mesh.  The estimate uses only
+        # queue-time counts — it never stages or exposes anything.
+        pol = policy if policy is not None else _routing.default_policy()
+        est = (max(_routing.estimate_device_terms(v)
+                   for v in verifiers) if verifiers else 0)
+        mesh = pol.choose_mesh(est, health=health)
+    # mesh <= 1 is single-device dispatch: normalize EARLY so the lane,
+    # the health object, the shard padding, and the shape-completed
+    # grace keys all agree across call sites.
+    mesh = _health.normalize_mesh(mesh)
+    if health is None:
+        health = _health.health_for(mesh)
+    now = health.now
+
     verdicts = [False] * len(verifiers)
     remaining = list(range(len(verifiers)))  # tail = host-lane candidates
     _t_begin = _time.monotonic()
     stats = {
         "batches": len(verifiers),
         "sigs": sum(v.batch_size for v in verifiers),
+        "mesh": mesh,  # the RESOLVED dispatch mode (0 = single device)
         "host_batches": 0,
         "device_batches": 0,
         "device_sick": False,
@@ -1838,8 +1910,29 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             t_start = dev.started_at(cid)
             deadline = (t_start + budget) if t_start is not None \
                 else (t0 + budget + 10.0)
-            timeout = max(0.0, deadline - now()) if block else 0.0
-            res = dev.wait(cid, timeout)
+            if block and t_start is None:
+                # The call has not visibly STARTED yet, so the deadline
+                # above carries the queued-chunk grace.  Wait in short
+                # slices and re-derive the moment the worker enters the
+                # call — a one-shot wait on the grace deadline would let
+                # a stalled FIRST call hide inside the +10 s slack (the
+                # main thread computes the deadline before the worker
+                # thread is even scheduled), and a seized tunnel on the
+                # very first chunk would dodge the miss machinery the
+                # service breaker feeds on.
+                while True:
+                    res = dev.wait(
+                        cid, min(0.25, max(0.0, deadline - now())))
+                    if res is not _PENDING:
+                        break
+                    t_start = dev.started_at(cid)
+                    if t_start is not None:
+                        deadline = t_start + budget
+                    if now() >= deadline:
+                        break
+            else:
+                timeout = max(0.0, deadline - now()) if block else 0.0
+                res = dev.wait(cid, timeout)
             if res is _PENDING:
                 t_start = dev.started_at(cid)
                 deadline = (t_start + budget) if t_start is not None \
@@ -2084,13 +2177,16 @@ def verify_single_many(entries, rng=None) -> "list[bool]":
     by_key = {vkb: iter(ksigs)
               for vkb, ksigs in staging._materialized().items()}
     verifiers = []
-    poison = [(0, Signature(b"\xff" * 32, b"\xff" * 32))]
     for e in cleaned:
         v = Verifier()
         v.batch_size = 1
         if e is None:
-            # s = ff…ff ≥ ℓ: guaranteed staging rejection → verdict False
-            v.signatures[VerificationKeyBytes(b"\xff" * 32)] = poison
+            # Wire bytes never parsed into queueable objects: the
+            # explicit invalidation API forces the False verdict (the
+            # pre-round-6 version injected a crafted s ≥ ℓ poison
+            # signature by direct map assignment — same verdict, but
+            # manufactured data instead of stated intent).
+            v.invalidate("malformed wire bytes")
         else:
             vkb = e[0]
             v.signatures[vkb] = [next(by_key[vkb])]

@@ -55,8 +55,9 @@ import numpy as np
 __all__ = [
     "SITE_LANE", "SITE_SHARDED", "InjectedFault", "LaneDeathSignal",
     "Fault", "ErrorOn", "StallFor", "FlappingLink", "CorruptSum",
-    "KillLane", "FaultPlan", "randomized_plan", "install", "uninstall",
-    "injected", "active_plan", "run_device_call",
+    "KillLane", "FaultPlan", "randomized_plan", "storm_plan",
+    "install", "uninstall", "injected", "active_plan",
+    "run_device_call",
 ]
 
 SITE_LANE = "lane"
@@ -312,6 +313,45 @@ def randomized_plan(seed: int, error_rate: float = 0.1,
     ]
     if flap_period:
         faults.append(FlappingLink(period=flap_period, site=site))
+    return FaultPlan(faults, seed=seed)
+
+
+def storm_plan(seed: int, kind: str, at: int = 0, length: int = 1,
+               seconds: float = 6.0, site: str = SITE_LANE,
+               period: int = 2, advance: float = 3600.0) -> FaultPlan:
+    """An overload/crash schedule for the service-layer soaks: one
+    contiguous WINDOW of faults over the device-call stream — the shape
+    of a real incident (a storm hits, persists for a while, passes) as
+    opposed to randomized_plan's memoryless per-call draws.
+
+    `kind`:
+
+    * ``"error"`` — every call in [at, at+length) raises (crash storm).
+    * ``"stall"`` — every call in the window stalls `seconds` (default
+      6 s — above the scheduler's deadline budget for a full warmed
+      8-batch chunk, 3×EMA-prior×8 = 4.8 s, so a window on a real
+      clock deterministically blows deadlines; virtual clocks advance
+      instead of sleeping).
+    * ``"crash"`` — the lane worker dies at call `at` (device death
+      mid-queue; `advance` pre-ages a virtual clock so the orphaned
+      chunk's deadline expires deterministically).  `length` further
+      deaths hit the replacement lanes at consecutive calls.
+    * ``"flap"`` — a FlappingLink of `period` for the whole stream
+      (`at`/`length` ignored — flapping has no window).
+
+    The plan replays exactly like every other FaultPlan: decisions are
+    pure functions of (seed, site, call index)."""
+    window = range(at, at + max(1, length))
+    if kind == "error":
+        faults = [ErrorOn(on=window, site=site)]
+    elif kind == "stall":
+        faults = [StallFor(seconds, on=window, site=site)]
+    elif kind == "crash":
+        faults = [KillLane(on=window, advance=advance)]
+    elif kind == "flap":
+        faults = [FlappingLink(period=period, site=site)]
+    else:
+        raise ValueError(f"unknown storm kind {kind!r}")
     return FaultPlan(faults, seed=seed)
 
 

@@ -37,11 +37,12 @@ THREAD SEMANTICS (the documented contract):
   injected test instances.
 """
 
+import hashlib
 import threading
 import time
 
 __all__ = [
-    "Clock", "FakeClock", "SYSTEM_CLOCK", "DeviceHealth",
+    "Clock", "FakeClock", "SYSTEM_CLOCK", "DeviceHealth", "Backoff",
     "normalize_mesh", "health_for", "reset_all", "any_lane_stuck",
     "set_any_lane_stuck",
 ]
@@ -284,6 +285,86 @@ class DeviceHealth:
                 f"unresolved_probe_streak={self._unresolved_probe_streak}, "
                 f"lane_stuck={self._lane_stuck})"
             )
+
+
+class Backoff:
+    """Deterministic seeded-jitter exponential backoff on an injectable
+    Clock — the wait discipline of the VerifyService circuit breaker
+    (service.py), kept here with the other time machinery.
+
+    `arm()` starts (or lengthens) a wait: attempt k waits
+    base·factor^(k−1), capped at `max_delay`, scaled by a jitter factor
+    drawn UNIFORMLY from [1−jitter, 1+jitter] as a pure function of
+    (seed, attempt) — two runs of the same schedule back off
+    identically (same replay property as faults.FaultPlan), while
+    distinct seeds decorrelate a fleet's re-probe stampede.  `reset()`
+    returns to attempt 0.  Thread-safe; all timestamps come from the
+    injected clock, so FakeClock tests advance the wait explicitly."""
+
+    def __init__(self, clock: "Clock | None" = None, base: float = 1.0,
+                 factor: float = 2.0, max_delay: float = 60.0,
+                 jitter: float = 0.25, seed: int = 0):
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.base = float(base)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._attempt = 0
+        self._until = 0.0
+
+    def _jitter_factor(self, attempt: int) -> float:
+        digest = hashlib.sha256(
+            repr((self.seed, attempt, "backoff")).encode()).digest()
+        u = int.from_bytes(digest[:8], "little") / float(1 << 64)
+        return 1.0 - self.jitter + 2.0 * self.jitter * u
+
+    def delay_for(self, attempt: int) -> float:
+        """The delay attempt `attempt` (1-based) waits — pure function,
+        for schedule inspection in tests and the load soak."""
+        if attempt < 1:
+            return 0.0
+        raw = min(self.base * self.factor ** (attempt - 1),
+                  self.max_delay)
+        return raw * self._jitter_factor(attempt)
+
+    def arm(self) -> float:
+        """Record a failure: advance to the next attempt and arm its
+        delay from now.  Returns the armed delay (seconds)."""
+        with self._lock:
+            self._attempt += 1
+            d = self.delay_for(self._attempt)
+            self._until = self.clock.monotonic() + d
+            return d
+
+    def expired(self) -> bool:
+        """True once the armed delay has elapsed (or none is armed)."""
+        with self._lock:
+            return self.clock.monotonic() >= self._until
+
+    def reset(self) -> None:
+        with self._lock:
+            self._attempt = 0
+            self._until = 0.0
+
+    @property
+    def attempt(self) -> int:
+        with self._lock:
+            return self._attempt
+
+    @property
+    def until(self) -> float:
+        with self._lock:
+            return self._until
+
+    def __repr__(self):
+        with self._lock:
+            return (f"Backoff(attempt={self._attempt}, "
+                    f"until={self._until:.3f}, base={self.base}, "
+                    f"max_delay={self.max_delay})")
 
 
 # -- per-mesh registry ----------------------------------------------------

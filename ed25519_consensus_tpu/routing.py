@@ -1,0 +1,183 @@
+"""Explicit host / device / sharded-mesh routing policy.
+
+Until round 6 the "shard only large batches" rule lived in prose (the
+round-5 scaling lab derived the crossover model, BASELINE.md mesh
+section; the round-5 verdict flagged that nothing applies it) and mesh
+selection was a manual `verify_many(mesh=D)` knob.  This module makes
+the policy a first-class object:
+
+* **The N* crossover model** (tools/mesh_scaling_lab.py, r5): a sharded
+  dispatch over D devices pays a fixed cost `a` (dispatch + the
+  all-gather of D partial window-sum tensors + the D-step Edwards fold)
+  and a per-term cost `b/D`; a single device pays `b` per term.  D
+  devices beat one when N·b > a + N·b/D, i.e. above
+
+      N*(D) = a / (b · (1 − 1/D))
+
+  With the r5 constants (a ≈ 30 ms tunneled fixed cost, b ≈ 1.3 µs/term
+  on-chip), N* ≈ 26k terms — a 3-4k-signature batch.  Both constants
+  are policy parameters (and env-overridable) because they are
+  DEPLOYMENT measurements, not universal truths.
+
+* **Live DeviceHealth**: a mesh whose health has a cooldown/pause armed
+  is not routed to, whatever the term count — the crossover model says
+  where sharding *would* win, the health object says whether the mesh
+  is currently trustworthy.
+
+`verify_many(mesh=None)` consults the default policy per call (the
+batch sizes it was handed estimate the per-chunk term count) and
+auto-selects the mesh lane only above the crossover on an available
+multi-device backend; `verify_many(mesh=D)` remains a manual override
+that never consults the policy, and `mesh=0`/`mesh=1` explicitly forces
+the single-device lane.  The VerifyService (service.py) uses the same
+policy object for its route step.
+
+Env knobs (config surface, SURVEY.md §5):
+
+* ``ED25519_TPU_AUTO_MESH=0``    — disable auto-selection (auto always
+  resolves to the single-device lane).
+* ``ED25519_TPU_MESH_FIXED_COST`` / ``ED25519_TPU_MESH_PER_TERM`` —
+  override the a / b constants (seconds, seconds-per-term) for the
+  default policy, e.g. after re-running the scaling lab on new
+  hardware.
+"""
+
+import os
+import threading
+
+from . import health as _health
+
+__all__ = [
+    "RoutingPolicy", "default_policy", "set_default_policy",
+    "available_devices", "estimate_device_terms",
+]
+
+# r5 scaling-lab constants (BASELINE.md mesh section): tunneled per-call
+# fixed cost and on-chip per-term cost.
+DEFAULT_FIXED_COST_S = 0.030
+DEFAULT_PER_TERM_S = 1.3e-6
+
+
+# Memoized device probe: the count cannot change within a process, and
+# auto-routing consults it on EVERY default verify_many call — on a
+# jax-less host (the supported no-accelerator mode) an uncached probe
+# would re-raise ImportError (failed imports are not cached in
+# sys.modules) and pay a sys.path scan per call on what used to be a
+# zero-overhead path.  The env check stays live: DISABLE_DEVICE must
+# keep jax unloaded even if flipped mid-process.
+_device_count = [None]
+
+
+def available_devices() -> int:
+    """Addressable accelerator device count, 0 when the device stack is
+    unavailable or explicitly disabled.  Never imports jax when
+    ED25519_TPU_DISABLE_DEVICE is set — the knob's contract is that the
+    accelerator stack stays entirely unloaded."""
+    if os.environ.get("ED25519_TPU_DISABLE_DEVICE", "").lower() in (
+            "1", "true", "yes"):
+        return 0
+    if _device_count[0] is None:
+        try:
+            import jax
+
+            _device_count[0] = jax.device_count()
+        except Exception:
+            _device_count[0] = 0
+    return _device_count[0]
+
+
+def estimate_device_terms(verifier) -> int:
+    """Estimated device MSM term count for one batch WITHOUT staging it:
+    n signature terms + (m+1) coefficient terms + up to (m+1) split-high
+    terms (staging splits every >128-bit coefficient; with random
+    blinders essentially all of them split, StagedBatch.n_device_terms).
+    Uses only `batch_size` and `distinct_key_count`, so the estimate
+    never materializes or exposes the coalescing map."""
+    m = verifier.distinct_key_count
+    return verifier.batch_size + 2 * (m + 1)
+
+
+class RoutingPolicy:
+    """Pick the dispatch mode (0 = single-device lane, D = D-device
+    sharded mesh) for a verify_many call from the crossover model plus
+    live health.  Immutable after construction; thread-safe by virtue of
+    having no mutable state."""
+
+    def __init__(self, fixed_cost_s: float = None,
+                 per_term_s: float = None,
+                 min_devices: int = 2,
+                 auto_mesh: bool = None):
+        def _env_f(name, fallback):
+            try:
+                return float(os.environ.get(name, "") or fallback)
+            except ValueError:
+                return fallback
+
+        self.fixed_cost_s = (fixed_cost_s if fixed_cost_s is not None
+                             else _env_f("ED25519_TPU_MESH_FIXED_COST",
+                                         DEFAULT_FIXED_COST_S))
+        self.per_term_s = (per_term_s if per_term_s is not None
+                           else _env_f("ED25519_TPU_MESH_PER_TERM",
+                                       DEFAULT_PER_TERM_S))
+        self.min_devices = int(min_devices)
+        if auto_mesh is None:
+            auto_mesh = os.environ.get(
+                "ED25519_TPU_AUTO_MESH", "").lower() not in (
+                "0", "false", "no")
+        self.auto_mesh = bool(auto_mesh)
+
+    def crossover_terms(self, n_devices: int) -> float:
+        """N*(D) — the per-batch term count above which a D-device
+        sharded dispatch beats the single device.  Infinite for D <= 1
+        (sharding over one device can only add collective overhead)."""
+        if n_devices <= 1:
+            return float("inf")
+        return self.fixed_cost_s / (
+            self.per_term_s * (1.0 - 1.0 / n_devices))
+
+    def choose_mesh(self, est_terms_per_batch: int,
+                    n_devices: int = None,
+                    health: "_health.DeviceHealth | None" = None) -> int:
+        """The dispatch mode for batches of ~`est_terms_per_batch` device
+        terms: the full available mesh D when sharding clears N*(D) AND
+        the mesh's live health allows the device, else 0 (single-device
+        lane; verify_many's own probe/health machinery still decides
+        host vs device from there).  `health` defaults to the process
+        health for the candidate mesh."""
+        if not self.auto_mesh:
+            return 0
+        d = available_devices() if n_devices is None else int(n_devices)
+        if d < self.min_devices:
+            return 0
+        if est_terms_per_batch <= self.crossover_terms(d):
+            return 0
+        h = health if health is not None else _health.health_for(d)
+        if not h.device_allowed():
+            return 0
+        return d
+
+    def __repr__(self):
+        return (f"RoutingPolicy(fixed_cost_s={self.fixed_cost_s}, "
+                f"per_term_s={self.per_term_s}, "
+                f"min_devices={self.min_devices}, "
+                f"auto_mesh={self.auto_mesh})")
+
+
+_default = [None]
+_default_lock = threading.Lock()
+
+
+def default_policy() -> RoutingPolicy:
+    """The process default RoutingPolicy (constructed lazily so env
+    overrides set before first use take effect)."""
+    with _default_lock:
+        if _default[0] is None:
+            _default[0] = RoutingPolicy()
+        return _default[0]
+
+
+def set_default_policy(policy: "RoutingPolicy | None") -> None:
+    """Replace the process default policy (None resets to a fresh
+    env-derived one on next use)."""
+    with _default_lock:
+        _default[0] = policy

@@ -1,0 +1,606 @@
+"""Deadline-aware verification service: the front door for concurrent
+verification traffic.
+
+The library layer (`batch.verify_many`) has health-aware lane failover
+but no notion of concurrent callers, deadlines, queue bounds, or
+overload; a consensus node ingesting blocks and mempool gossip needs a
+service that degrades gracefully under load and device sickness without
+ever changing a verdict.  `VerifyService` is that front door — the
+reference's `batch::Verifier` (src/batch.rs) and dalek's `verify_batch`
+stop at single-call semantics; everything here is the TPU build's own
+service layer on top of the same exact math.
+
+The service-layer degradation ladder (docs/failure-model.md):
+
+1. **Admit** — a bounded queue (capacity in SIGNATURES, the unit device
+   cost scales with) with admission control: a submission that would
+   exceed capacity is rejected with `Overloaded` immediately, and a
+   high/low watermark pair adds hysteresis — once depth crosses the
+   high watermark the service sheds ALL new submissions until the queue
+   drains below the low watermark, so a saturated service does useful
+   work instead of thrashing at 100% occupancy.
+2. **Coalesce** — the dispatcher drains queued requests in waves and
+   hands each wave to `verify_many`, whose union-merge machinery
+   coalesces compatible small batches into stream-path super-batches
+   (one RLC equation, recurring keys collapse across submitters).
+3. **Route** — per wave, the `RoutingPolicy` (routing.py) picks
+   host / device / sharded-mesh from the N* crossover model plus live
+   `DeviceHealth`; a manual `mesh=` override is honored unchanged.
+4. **Shed** — per-request deadlines propagate: a request whose deadline
+   expired while queued is shed with `DeadlineExceeded` BEFORE dispatch
+   (never silently dropped); a request whose remaining budget is
+   smaller than the device-wave time estimate is routed host-side (the
+   host path has no multi-second tail), so an in-flight deadline is
+   honored by construction rather than by cancellation.  A request that
+   was already dispatched when its deadline passed still gets its
+   verdict — late truth beats a timely shrug.
+5. **Breaker** — device execution runs behind a supervised executor
+   with a circuit breaker (closed → open → half-open): crashes, stalls
+   (deadline blows), and error chunks count as failures; at the
+   threshold the breaker OPENS and every wave routes host-side; after a
+   seeded-jitter exponential backoff (`health.Backoff`, on the
+   injectable Clock) one HALF-OPEN probe wave re-tries the device
+   (forced-device, so the probe actually measures it) — success closes
+   the breaker, failure re-opens it with a doubled delay.
+
+Soundness is inherited, not re-argued: every verdict the service
+returns is decided by `verify_many`'s ladder (device results host-
+confirmed, all rejection decisions host-exact) or by the pure-host path
+directly — the service only ever chooses WHO does the work, never what
+the answer is.  Every submitted request resolves to exactly one of
+{verdict, `Overloaded`, `DeadlineExceeded`, `ServiceClosed`} — nothing
+is lost, which tools/load_soak.py asserts under seeded fault + overload
+schedules.
+"""
+
+import threading
+from collections import deque
+
+from . import batch as _batch
+from . import health as _health
+from . import routing as _routing
+from .error import Error
+from .utils import metrics as _metrics
+
+__all__ = [
+    "Overloaded", "DeadlineExceeded", "ServiceClosed",
+    "CircuitBreaker", "VerifyTicket", "VerifyService",
+    "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN",
+]
+
+
+class Overloaded(Error):
+    """The service's bounded queue cannot admit this submission (over
+    capacity, or shedding above the high watermark)."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__("Verification service overloaded."
+                         + (f" ({detail})" if detail else ""))
+
+
+class DeadlineExceeded(Error):
+    """The request's deadline expired before it was dispatched."""
+
+    def __init__(self):
+        super().__init__("Verification deadline exceeded.")
+
+
+class ServiceClosed(Error):
+    """The service was closed before this request could be decided."""
+
+    def __init__(self):
+        super().__init__("Verification service closed.")
+
+
+BREAKER_CLOSED = "closed"
+BREAKER_HALF_OPEN = "half-open"
+BREAKER_OPEN = "open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
+                  BREAKER_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Closed → open → half-open supervision of the device path.
+
+    * CLOSED: device allowed.  `failure_threshold` CONSECUTIVE failures
+      (error chunks, deadline blows, executor crashes) open it.
+    * OPEN: device forbidden; `health.Backoff` arms a seeded-jitter
+      exponential delay on the injected clock.  When the delay expires,
+      the next `allow_device()` transitions to HALF-OPEN and grants one
+      probe.
+    * HALF-OPEN: exactly one probe wave is in flight; success closes
+      the breaker (backoff reset), failure re-opens it with the next
+      (longer) delay.  A probe that never measured the device counts as
+      failure — an unobservable device is not a healthy one.
+
+    All transitions are recorded in utils.metrics ("breaker_opened",
+    "breaker_half_open", "breaker_closed") and mirrored in the
+    "breaker_state" gauge.  Thread-safe; time comes only from the
+    injected clock."""
+
+    def __init__(self, clock: "_health.Clock | None" = None,
+                 failure_threshold: int = 2,
+                 backoff: "_health.Backoff | None" = None,
+                 seed: int = 0):
+        self.clock = clock if clock is not None else _health.SYSTEM_CLOCK
+        self.failure_threshold = int(failure_threshold)
+        self.backoff = backoff if backoff is not None else _health.Backoff(
+            clock=self.clock, seed=seed)
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._transitions = []  # (state, clock time) history for tests
+
+    def _enter(self, state: str) -> None:
+        # under self._lock
+        self._state = state
+        self._transitions.append((state, self.clock.monotonic()))
+        _metrics.record_fault(
+            "breaker_" + {BREAKER_CLOSED: "closed",
+                          BREAKER_HALF_OPEN: "half_open",
+                          BREAKER_OPEN: "opened"}[state])
+        _metrics.set_gauge("breaker_state", _BREAKER_GAUGE[state])
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def transitions(self) -> "list[tuple]":
+        with self._lock:
+            return list(self._transitions)
+
+    def allow_device(self) -> "tuple[bool, bool]":
+        """(allowed, is_probe): whether the next wave may touch the
+        device, and whether it is the half-open probe (the dispatcher
+        forces device participation on probes so they resolve)."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True, False
+            if self._state == BREAKER_OPEN and self.backoff.expired():
+                self._enter(BREAKER_HALF_OPEN)
+                return True, True
+            # OPEN with the delay still running, or HALF_OPEN with the
+            # probe already granted (the dispatcher serializes waves, so
+            # a second caller here means the probe is in flight).
+            return False, False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != BREAKER_CLOSED:
+                self.backoff.reset()
+                self._enter(BREAKER_CLOSED)
+
+    def record_failure(self, kind: str = "failure") -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == BREAKER_HALF_OPEN or (
+                    self._state == BREAKER_CLOSED
+                    and self._consecutive_failures
+                    >= self.failure_threshold):
+                self.backoff.arm()
+                self._enter(BREAKER_OPEN)
+            elif self._state == BREAKER_OPEN:
+                # a failure while already open (e.g. the host fallback
+                # noticed more damage): lengthen the wait
+                self.backoff.arm()
+
+    def __repr__(self):
+        with self._lock:
+            return (f"CircuitBreaker(state={self._state!r}, "
+                    f"consecutive_failures={self._consecutive_failures}, "
+                    f"backoff={self.backoff!r})")
+
+
+class VerifyTicket:
+    """Handle for one submitted batch: resolves to a verdict (bool) or
+    raises the explicit outcome (`DeadlineExceeded`, `ServiceClosed`;
+    `Overloaded` is raised at submit time and never reaches a ticket)."""
+
+    __slots__ = ("_event", "_outcome", "_value")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._outcome = None  # "ok" | "err"
+        self._value = None
+
+    def _resolve(self, verdict: bool) -> None:
+        self._outcome, self._value = "ok", bool(verdict)
+        self._event.set()
+
+    def _fail(self, exc: Exception) -> None:
+        self._outcome, self._value = "err", exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: "float | None" = None) -> bool:
+        """Block (wall time) for the outcome.  Returns the verdict or
+        raises the request's explicit error; raises TimeoutError if the
+        outcome has not landed within `timeout`."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("verification result not ready")
+        if self._outcome == "ok":
+            return self._value
+        raise self._value
+
+
+class _Request:
+    __slots__ = ("verifier", "deadline", "ticket", "sigs")
+
+    def __init__(self, verifier, deadline, sigs):
+        self.verifier = verifier
+        self.deadline = deadline  # absolute service-clock time or None
+        self.ticket = VerifyTicket()
+        self.sigs = sigs
+
+
+class _HostOnlyHealth(_health.DeviceHealth):
+    """A DeviceHealth that never allows the device: handing it to
+    verify_many IS the host route (the disable gate takes the pure-host
+    loop before any lane or jax import).  Shares the service clock so
+    scheduling timestamps stay on one timeline."""
+
+    def __init__(self, clock):
+        super().__init__(mesh=0, clock=clock)
+
+    def device_allowed(self) -> bool:
+        return False
+
+
+class VerifyService:
+    """Bounded, deadline-aware, breaker-supervised verification front
+    door over `batch.verify_many` — see the module docstring for the
+    degradation ladder.
+
+    Parameters (all optional — defaults serve a single-device node):
+
+    * capacity_sigs / high_watermark / low_watermark — admission
+      control: absolute signature capacity and the shed/resume
+      hysteresis fractions.
+    * wave_max_batches — max requests drained per dispatcher wave.
+    * chunk / hybrid / merge / mesh / policy — forwarded to
+      `verify_many` (mesh=None keeps auto-routing; an explicit mesh is
+      the manual override).
+    * clock — injectable monotonic clock for ALL service time
+      (deadlines, breaker backoff); `health.FakeClock` makes every
+      admission/shed/breaker decision deterministic in tests.
+    * breaker — injectable CircuitBreaker (built from `clock` and
+      `breaker_seed` by default).
+    * device_time_prior — seconds a device wave is assumed to take
+      before the first measurement; a request whose remaining deadline
+      budget is below the current estimate routes host-side.
+    * auto_start — start the dispatcher thread; pass False for
+      deterministic single-threaded tests driving `process_once()`.
+
+    Thread semantics: `submit` is callable from any number of threads;
+    one dispatcher (thread or `process_once` caller) executes waves —
+    the service SERIALIZES its own verify_many calls, and reading
+    `batch.last_run_stats` right after each call is sound under that
+    serialization (concurrent out-of-band verify_many callers would
+    race the snapshot; run them through the service instead)."""
+
+    def __init__(self, *, capacity_sigs: int = 65536,
+                 high_watermark: float = 0.85,
+                 low_watermark: float = 0.50,
+                 wave_max_batches: int = 64,
+                 chunk: int = 8, hybrid: bool = True, merge: str = "auto",
+                 mesh: "int | None" = None,
+                 policy: "_routing.RoutingPolicy | None" = None,
+                 health: "_health.DeviceHealth | None" = None,
+                 clock: "_health.Clock | None" = None,
+                 breaker: "CircuitBreaker | None" = None,
+                 breaker_failure_threshold: int = 2,
+                 breaker_seed: int = 0,
+                 device_time_prior: float = 2.0,
+                 rng=None, auto_start: bool = True):
+        if not 0.0 < low_watermark <= high_watermark <= 1.0:
+            raise ValueError(
+                "watermarks must satisfy 0 < low <= high <= 1")
+        self.capacity_sigs = int(capacity_sigs)
+        self._high_sigs = high_watermark * self.capacity_sigs
+        self._low_sigs = low_watermark * self.capacity_sigs
+        self.wave_max_batches = int(wave_max_batches)
+        self.chunk = chunk
+        self.hybrid = hybrid
+        self.merge = merge
+        self.mesh = mesh
+        self.policy = policy
+        self.health = health
+        self._clock = clock if clock is not None else (
+            health.clock if health is not None else _health.SYSTEM_CLOCK)
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            clock=self._clock,
+            failure_threshold=breaker_failure_threshold,
+            seed=breaker_seed)
+        self._device_estimate = float(device_time_prior)
+        self._rng = rng
+        self._host_health = _HostOnlyHealth(self._clock)
+
+        self._cv = threading.Condition()
+        self._queue: "deque[_Request]" = deque()
+        self._queue_sigs = 0
+        self._shedding = False
+        self._closed = False
+        self.totals = {
+            "submitted": 0, "resolved": 0, "rejected_overloaded": 0,
+            "shed_deadline": 0, "waves": 0, "host_waves": 0,
+            "device_waves": 0, "probe_waves": 0, "crash_fallbacks": 0,
+        }
+        self._thread = None
+        if auto_start:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="ed25519-verify-service")
+            self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+
+    def now(self) -> float:
+        return self._clock.monotonic()
+
+    def submit(self, entries, deadline: "float | None" = None,
+               timeout: "float | None" = None) -> VerifyTicket:
+        """Submit one batch: a `batch.Verifier` (ownership transfers to
+        the service — do not mutate or verify it afterwards) or an
+        iterable of `(vk_bytes, sig, msg)` entries.  `deadline` is an
+        absolute service-clock time, `timeout` a relative convenience
+        (both given: the earlier wins); None means no deadline.
+
+        Returns a `VerifyTicket`; raises `Overloaded` when the bounded
+        queue cannot admit the batch (beyond capacity, or shedding
+        between the watermarks) and `ServiceClosed` after `close()`.
+        Admission is decided HERE, synchronously — an admitted request
+        is never later dropped for load."""
+        if isinstance(entries, _batch.Verifier):
+            v = entries
+        else:
+            v = _batch.Verifier()
+            v.queue_bulk(list(entries))
+        if timeout is not None:
+            t = self.now() + float(timeout)
+            deadline = t if deadline is None else min(deadline, t)
+        req = _Request(v, deadline, v.batch_size)
+        with self._cv:
+            if self._closed:
+                raise ServiceClosed()
+            self.totals["submitted"] += 1
+            # Watermark hysteresis: crossing high arms shedding; only
+            # draining below low (dispatcher side) disarms it.
+            if self._queue_sigs >= self._high_sigs:
+                self._set_shedding(True)
+            if self._shedding:
+                self.totals["rejected_overloaded"] += 1
+                _metrics.record_fault("service_reject_overloaded")
+                raise Overloaded(
+                    f"shedding above high watermark "
+                    f"({self._queue_sigs} sigs queued)")
+            if self._queue_sigs + req.sigs > self.capacity_sigs:
+                self.totals["rejected_overloaded"] += 1
+                _metrics.record_fault("service_reject_overloaded")
+                raise Overloaded(
+                    f"queue full ({self._queue_sigs}+{req.sigs} "
+                    f"> {self.capacity_sigs} sigs)")
+            self._queue.append(req)
+            self._queue_sigs += req.sigs
+            self._update_gauges()
+            self._cv.notify_all()
+        return req.ticket
+
+    def _set_shedding(self, flag: bool) -> None:
+        # under self._cv
+        if self._shedding != flag:
+            self._shedding = flag
+            _metrics.set_gauge("service_shedding", int(flag))
+
+    def _update_gauges(self) -> None:
+        # under self._cv
+        _metrics.set_gauge("service_queue_sigs", self._queue_sigs)
+        _metrics.set_gauge("service_queue_requests", len(self._queue))
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _take_wave(self, block: bool) -> "list[_Request]":
+        with self._cv:
+            if block:
+                while not self._queue and not self._closed:
+                    self._cv.wait(0.05 if self._clock.virtual else None)
+            wave = []
+            while self._queue and len(wave) < self.wave_max_batches:
+                req = self._queue.popleft()
+                self._queue_sigs -= req.sigs
+                wave.append(req)
+            if self._shedding and self._queue_sigs <= self._low_sigs:
+                self._set_shedding(False)
+            self._update_gauges()
+            return wave
+
+    def process_once(self, block: bool = False) -> int:
+        """One dispatcher iteration: drain a wave, shed expired
+        requests, route, execute, resolve.  Returns the number of
+        requests resolved.  The background dispatcher calls this in a
+        loop; tests with `auto_start=False` call it directly for
+        deterministic single-threaded scheduling."""
+        wave = self._take_wave(block)
+        if not wave:
+            return 0
+        now = self.now()
+        live = []
+        for req in wave:
+            if req.deadline is not None and now >= req.deadline:
+                # Shed BEFORE dispatch: expired requests must not spend
+                # device/host time, and must resolve explicitly.
+                self.totals["shed_deadline"] += 1
+                _metrics.record_fault("service_shed_deadline")
+                req.ticket._fail(DeadlineExceeded())
+            else:
+                live.append(req)
+        resolved = len(wave) - len(live)
+        if not live:
+            self.totals["waves"] += 1
+            return resolved
+
+        # Route: requests whose remaining budget is below the device
+        # wave estimate fall back host-side NOW (the in-flight rung of
+        # the ladder); the rest go wherever the breaker allows.
+        urgent, routable = [], []
+        for req in live:
+            if (req.deadline is not None
+                    and req.deadline - now < self._device_estimate):
+                urgent.append(req)
+            else:
+                routable.append(req)
+        probe = False
+        if routable:
+            # Consult the breaker ONLY when a device wave would actually
+            # run: allow_device() consumes the half-open probe token,
+            # and granting it to a wave that turns out to be all-urgent
+            # (likely exactly during an outage, when deadline-carrying
+            # traffic is backed up) would latch the breaker HALF_OPEN
+            # forever — no probe ever executes, no transition ever
+            # fires, the device is silently lost.
+            allowed, probe = self.breaker.allow_device()
+            if not allowed:
+                urgent, routable = urgent + routable, []
+        self.totals["waves"] += 1
+        if urgent:
+            self.totals["host_waves"] += 1
+            _metrics.record_fault("service_host_routed_waves")
+            self._execute(urgent, device=False, probe=False)
+        if routable:
+            self.totals["device_waves"] += 1
+            if probe:
+                self.totals["probe_waves"] += 1
+            self._execute(routable, device=True, probe=probe)
+        return resolved + len(live)
+
+    def _execute(self, reqs, device: bool, probe: bool) -> None:
+        """Run one routed group through verify_many under supervision:
+        whatever happens — device sickness, injected storms, even an
+        exception escaping the scheduler — every ticket resolves, and
+        verdicts only ever come from ladder-decided math."""
+        vs = [r.verifier for r in reqs]
+        try:
+            if device:
+                # Probe waves force device participation (hybrid=False):
+                # a half-open breaker needs evidence, and a host-raced
+                # probe that never measures the device would stay
+                # half-open forever.
+                verdicts = _batch.verify_many(
+                    vs, rng=self._rng, chunk=self.chunk,
+                    hybrid=False if probe else self.hybrid,
+                    merge=self.merge, mesh=self.mesh,
+                    health=self.health, policy=self.policy)
+                stats = dict(_batch.last_run_stats)
+                self._note_device_outcome(stats, probe)
+            else:
+                verdicts = _batch.verify_many(
+                    vs, rng=self._rng, chunk=self.chunk, hybrid=True,
+                    merge=self.merge, mesh=0, health=self._host_health)
+        except Exception:
+            # Supervised-executor rung: an exception out of verify_many
+            # (crashed runtime, injected chaos beyond the lane seams)
+            # must neither lose requests nor poison the service.  The
+            # breaker counts it; every batch is re-decided host-side.
+            self.totals["crash_fallbacks"] += 1
+            _metrics.record_fault("service_crash_fallback")
+            if device:
+                self.breaker.record_failure("crash")
+            verdicts = []
+            for v in vs:
+                try:
+                    verdicts.append(_batch._host_verdict(v, self._rng))
+                except Exception as exc:  # host path itself failed: the
+                    verdicts.append(exc)  # ticket carries the evidence
+        for req, verdict in zip(reqs, verdicts):
+            if isinstance(verdict, Exception):
+                req.ticket._fail(verdict)
+            else:
+                req.ticket._resolve(verdict)
+            self.totals["resolved"] += 1
+
+    def _note_device_outcome(self, stats: dict, probe: bool) -> None:
+        """Feed one device-routed wave's verify_many stats to the
+        breaker and the wave-time estimate."""
+        failed = bool(stats.get("device_sick")) \
+            or stats.get("device_errors", 0) > 0
+        participated = (
+            stats.get("device_batches", 0)
+            + stats.get("device_unions", 0)
+            + stats.get("device_rejects_confirmed", 0)
+            + stats.get("device_rejects_overturned", 0))
+        if failed:
+            self.breaker.record_failure(
+                "stall" if stats.get("device_sick") else "error")
+        elif participated:
+            self.breaker.record_success()
+            # EMA of the device wave time — the in-flight deadline
+            # rung's estimate of "how long does handing a wave to the
+            # device risk taking".
+            dt = float(stats.get("seconds", 0.0))
+            if dt > 0:
+                self._device_estimate = (
+                    0.6 * self._device_estimate + 0.4 * dt)
+        elif probe:
+            # The forced-device probe never measured the device (e.g. a
+            # cold-shape compile grace drained everything host-side):
+            # an unobservable device is not a healthy one — back off
+            # again rather than flapping closed.
+            self.breaker.record_failure("probe_unresolved")
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed and not self._queue:
+                    return
+            self.process_once(block=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot: queue depth, admission state, breaker state, and
+        the lifetime totals."""
+        with self._cv:
+            return {
+                "queue_sigs": self._queue_sigs,
+                "queue_requests": len(self._queue),
+                "shedding": self._shedding,
+                "closed": self._closed,
+                "breaker_state": self.breaker.state,
+                "device_estimate_s": self._device_estimate,
+                **self.totals,
+            }
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting; by default DRAIN the queue (every pending
+        request still resolves — nothing lost), then stop the
+        dispatcher.  `drain=False` resolves pending requests with
+        `ServiceClosed` instead (still explicit, still nothing lost)."""
+        pending = []
+        with self._cv:
+            self._closed = True
+            if not drain:
+                pending = list(self._queue)
+                self._queue.clear()
+                self._queue_sigs = 0
+                self._update_gauges()
+            self._cv.notify_all()
+        for req in pending:
+            req.ticket._fail(ServiceClosed())
+            self.totals["resolved"] += 1
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+        else:
+            while drain and self.process_once(block=False):
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -19,6 +19,15 @@ from contextlib import contextmanager
 # signature rejection), "device_reject_overturned" (host restored a
 # valid batch — the corruption signal to alert on), and
 # "probe_backoff_armed".
+#
+# The service layer (service.py) records its admission/breaker
+# transitions in the same registry: "service_reject_overloaded"
+# (submission rejected at the admission gate), "service_shed_deadline"
+# (request expired before dispatch), "service_host_routed_waves"
+# (a wave routed host-side by breaker/deadline), "service_crash_fallback"
+# (the supervised executor caught an escaped exception and re-decided
+# the wave host-side), and the breaker transitions "breaker_opened",
+# "breaker_half_open", "breaker_closed".
 
 _fault_lock = threading.Lock()
 _fault_counters: dict = {}
@@ -38,6 +47,33 @@ def fault_counters() -> dict:
 def reset_fault_counters() -> None:
     with _fault_lock:
         _fault_counters.clear()
+
+
+# -- gauges ----------------------------------------------------------------
+# Last-value instruments for states that are levels, not events: the
+# service's queue depth ("service_queue_sigs", "service_queue_requests"),
+# its admission state ("service_shedding": 0/1), and the breaker state
+# ("breaker_state": 0 closed / 1 half-open / 2 open).  Same process-wide
+# registry discipline as the counters.
+
+_gauge_lock = threading.Lock()
+_gauges: dict = {}
+
+
+def set_gauge(name: str, value) -> None:
+    with _gauge_lock:
+        _gauges[name] = value
+
+
+def gauges() -> dict:
+    """Snapshot of the process-wide gauge registry."""
+    with _gauge_lock:
+        return dict(_gauges)
+
+
+def reset_gauges() -> None:
+    with _gauge_lock:
+        _gauges.clear()
 
 
 class BatchMetrics:

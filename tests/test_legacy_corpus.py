@@ -91,16 +91,18 @@ def test_legacy_oracle_matches_independent_corpus():
     assert deltas > 0
 
 
-def test_corpus_matches_live_openssl():
-    """Regenerate a sample of verdicts against the host's OpenSSL: guards
-    the committed corpus against silent staleness.  Skips only if the
-    cryptography wheel disappears from the image."""
+def _live_openssl():
+    """The live independent implementation, or None when the wheel is
+    absent.  The reference links its independent oracle at every test
+    run (reference Cargo.toml:27); with the `cryptography` wheel
+    importable these tests do the same — the committed corpus is then a
+    REPLAY check, not the only line of defense."""
     try:
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
             Ed25519PublicKey,
         )
     except ImportError:  # pragma: no cover
-        pytest.skip("cryptography not available")
+        return None
 
     def live(vk, sig, msg):
         try:
@@ -109,10 +111,71 @@ def test_corpus_matches_live_openssl():
         except Exception:
             return False
 
-    for c in CORPUS["cases"][::5]:
+    return live
+
+
+def test_corpus_matches_live_openssl():
+    """Regenerate verdicts for EVERY committed case against the host's
+    OpenSSL (VERDICT r5 next-round #5 — live-when-available, the full
+    corpus, not a sample): guards the committed corpus against silent
+    staleness.  Skips only if the cryptography wheel disappears from
+    the image (CI installs it)."""
+    live = _live_openssl()
+    if live is None:  # pragma: no cover
+        pytest.skip("cryptography not available")
+    for c in CORPUS["cases"]:
         vk, sig = bytes.fromhex(c["vk"]), bytes.fromhex(c["sig"])
         msg = bytes.fromhex(c["msg"])
         assert live(vk, sig, msg) == c["openssl"], (
             f"corpus stale vs live OpenSSL: {c['kind']} vk={c['vk']} "
             f"sig={c['sig']}"
         )
+
+
+def test_legacy_oracle_matches_live_openssl_on_fresh_cases():
+    """The live differential on cases that exist in NO committed file:
+    fresh random keys/messages with seeded mutations, verdicts drawn
+    from OpenSSL at test time and mapped through the two documented
+    rule deltas.  A shared misreading between legacy_verify and the
+    committed corpus generator cannot survive this — the inputs did
+    not exist when either was written."""
+    import random
+
+    from ed25519_consensus_tpu import SigningKey
+    from ed25519_consensus_tpu.ops.scalar import L
+
+    live = _live_openssl()
+    if live is None:  # pragma: no cover
+        pytest.skip("cryptography not available")
+    rng = random.Random(0x11FE)  # seeded: failures replay exactly
+    checked = 0
+    for i in range(24):
+        sk = SigningKey.new(rng)
+        vk = sk.verification_key_bytes().to_bytes()
+        msg = b"live-fresh-%d" % i + rng.randbytes(8)
+        sig = sk.sign(msg)
+        raw = sig.R_bytes + sig.s_bytes
+        variants = [(vk, raw, msg)]
+        # tampered message / flipped R bit / flipped s bit
+        variants.append((vk, raw, msg + b"!"))
+        r_flip = bytearray(raw)
+        r_flip[rng.randrange(32)] ^= 1 << rng.randrange(8)
+        variants.append((vk, bytes(r_flip), msg))
+        s_flip = bytearray(raw)
+        s_flip[32 + rng.randrange(31)] ^= 1 << rng.randrange(8)
+        variants.append((vk, bytes(s_flip), msg))
+        # malleated s' = s + ℓ (legacy AND OpenSSL both require
+        # canonical s — the delta map must be identity here)
+        s_int = int.from_bytes(raw[32:], "little")
+        if s_int + L < 1 << 256:
+            mall = raw[:32] + (s_int + L).to_bytes(32, "little")
+            variants.append((vk, mall, msg))
+        for v_vk, v_sig, v_msg in variants:
+            want = _expected_legacy(v_vk, v_sig, live(v_vk, v_sig, v_msg))
+            got = legacy_verify(v_vk, v_sig, v_msg)
+            assert got == want, (
+                f"fresh case diverged: vk={v_vk.hex()} "
+                f"sig={v_sig.hex()} msg={v_msg.hex()}"
+            )
+            checked += 1
+    assert checked >= 100  # the differential actually ran at scale

@@ -1,0 +1,270 @@
+"""Service-layer overload soak: concurrent submitters, seeded deadlines,
+and seeded fault/overload storms against a small-capacity VerifyService —
+asserting, for every round, the acceptance bar of the service layer:
+
+* **Nothing lost**: every submitted batch resolves to exactly one of
+  {verdict, Overloaded, DeadlineExceeded} — counted per round, no
+  wall-time assertions anywhere.
+* **Host-identical verdicts**: every verdict the service returned is
+  bit-identical to the pure-host verdict of the same batch, whatever
+  the (injected) device did and however the breaker/queue behaved.
+
+Storm profiles (--storm; faults.storm_plan + request-side schedules):
+
+* ``none``     — pure overload: no device faults, capacity pressure only.
+* ``stall``    — a stall storm at the lane dispatch (calls sleep past the
+  scheduler's 2 s deadline floor → deadline misses, breaker food).
+* ``death``    — device death mid-queue (KillLane; the lane worker dies
+  with chunks in flight, replacement lanes die on the storm's window).
+* ``error``    — a crash storm (every call in the window raises).
+* ``deadline`` — a deadline storm on the REQUEST side: a third of the
+  submissions carry tight or already-expired deadlines.
+* ``mixed``    — randomized_plan faults + the deadline storm together.
+
+Usage:
+  python tools/load_soak.py [--seed 0x10AD] [--rounds 4] [--submitters 3]
+      [--requests 8] [--sigs 4] [--capacity-sigs 96] [--mesh 0]
+      [--storm mixed] [--json]
+
+Runs on any backend (CI uses the virtual 8-device CPU mesh).  Exits
+nonzero on any violation, printing the replay seed — plans and deadline
+schedules are pure functions of (seed, round), so failures reproduce
+with --seed N --rounds 1."""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ed25519_consensus_tpu import (  # noqa: E402
+    SigningKey, batch, faults, service,
+)
+from ed25519_consensus_tpu.utils import metrics  # noqa: E402
+
+from chaos_soak import warm_shapes  # noqa: E402  (same tools/ dir)
+
+
+def make_pool(rnd, keys, n_batches, sigs):
+    """Mixed valid/tampered batches (fixed size — one warmed chunk shape,
+    see chaos_soak.make_pool)."""
+    vs, want = [], []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        bad_at = rnd.randrange(sigs) if rnd.random() < 0.35 else -1
+        for j in range(sigs):
+            sk = rnd.choice(keys)
+            m = b"load %d %d" % (b, j)
+            sig = sk.sign(m)
+            if j == bad_at:
+                m += b"!"  # tamper
+            v.queue((sk.verification_key_bytes(), sig, m))
+        vs.append(v)
+        want.append(bad_at < 0)
+    return vs, want
+
+
+def storm_for(profile, seed, site):
+    if profile in ("none", "deadline"):
+        return None
+    if profile == "stall":
+        # default storm seconds: above the warmed 8-batch chunk budget,
+        # so the window deterministically blows deadlines
+        return faults.storm_plan(seed, "stall", at=1, length=3,
+                                 site=site)
+    if profile == "death":
+        return faults.storm_plan(seed, "crash", at=1, length=2)
+    if profile == "error":
+        return faults.storm_plan(seed, "error", at=0, length=6, site=site)
+    if profile == "mixed":
+        return faults.randomized_plan(seed, error_rate=0.2,
+                                      stall_rate=0.1, stall_seconds=0.3,
+                                      corrupt_rate=0.1, site=site)
+    raise SystemExit(f"unknown storm profile {profile!r}")
+
+
+def deadline_for(profile, rnd):
+    """Seeded per-request RELATIVE deadline (seconds from submit): None
+    (no deadline), generous, tight, or already expired — the
+    deadline-storm profiles skew tight."""
+    if profile in ("deadline", "mixed"):
+        r = rnd.random()
+        if r < 0.2:
+            return -1.0       # expired at submit: must shed
+        if r < 0.5:
+            return 0.05       # tight: host route or shed
+        return 120.0
+    return None if rnd.random() < 0.5 else 120.0
+
+
+def run_round(r, round_seed, args, keys, site):
+    rnd = random.Random(round_seed ^ 0x5EED)
+    vs, want = make_pool(rnd, keys,
+                         args.submitters * args.requests, args.sigs)
+    host_truth = [batch._host_verdict(v.clone(), random.Random(
+        round_seed ^ 0xB11D)) for v in vs]
+    assert host_truth == want, "host ground truth must match construction"
+
+    batch.reset_device_health()
+    svc = service.VerifyService(
+        capacity_sigs=args.capacity_sigs,
+        high_watermark=0.8, low_watermark=0.4,
+        wave_max_batches=6, chunk=8,
+        hybrid=False,  # force device participation (like chaos_soak)
+        # mesh passes through VERBATIM: 0 pins the single-device lane
+        # (the library-wide contract — auto-routing would desync the
+        # storm's fault `site` from the actual dispatch boundary)
+        merge="never", mesh=args.mesh,
+        breaker_failure_threshold=2, breaker_seed=round_seed,
+        rng=random.Random(round_seed ^ 0xB11D))
+    outcomes = [None] * len(vs)
+    drnd = random.Random(round_seed ^ 0xDEAD)
+    deadlines = [deadline_for(args.storm, drnd) for _ in vs]
+
+    def submitter(k):
+        # Submit the whole stream FIRST (queue pressure is the point of
+        # the soak — waiting per ticket would serialize depth to one),
+        # then collect every outcome.
+        base = k * args.requests
+        tickets = []
+        for i in range(args.requests):
+            idx = base + i
+            dl = deadlines[idx]
+            try:
+                t = svc.submit(
+                    vs[idx],
+                    deadline=None if dl is None else svc.now() + dl)
+            except service.Overloaded:
+                outcomes[idx] = "overloaded"
+                continue
+            except service.ServiceClosed:
+                outcomes[idx] = "closed"
+                continue
+            tickets.append((idx, t))
+        for idx, t in tickets:
+            try:
+                outcomes[idx] = t.result(timeout=120.0)
+            except service.DeadlineExceeded:
+                outcomes[idx] = "deadline"
+            except service.ServiceClosed:
+                outcomes[idx] = "closed"
+
+    plan = storm_for(args.storm, round_seed, site)
+    threads = [threading.Thread(target=submitter, args=(k,))
+               for k in range(args.submitters)]
+    if plan is not None:
+        faults.install(plan)
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        if plan is not None:
+            faults.uninstall()
+    svc.close()
+
+    lost = sum(1 for o in outcomes if o is None)
+    mismatches = [i for i, o in enumerate(outcomes)
+                  if isinstance(o, bool) and o != host_truth[i]]
+    tally = {
+        "verdicts": sum(isinstance(o, bool) for o in outcomes),
+        "overloaded": outcomes.count("overloaded"),
+        "deadline": outcomes.count("deadline"),
+        "closed": outcomes.count("closed"),
+    }
+    st = svc.stats()
+    rec = {
+        "round": r, "seed": round_seed, "storm": args.storm,
+        "lost": lost, "mismatches": len(mismatches),
+        "injected": 0 if plan is None else len(plan.injection_log()),
+        "breaker": st["breaker_state"],
+        "crash_fallbacks": st["crash_fallbacks"],
+        "host_waves": st["host_waves"], "device_waves": st["device_waves"],
+        **tally,
+    }
+    ok = lost == 0 and not mismatches
+    if not ok:
+        print(f"VIOLATION round={r} seed={round_seed:#x} lost={lost} "
+              f"mismatch_batches={mismatches} outcomes={outcomes} "
+              f"want={host_truth}", file=sys.stderr)
+    return ok, rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=lambda s: int(s, 0), default=0x10AD)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--submitters", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="batches per submitter per round")
+    ap.add_argument("--sigs", type=int, default=4,
+                    help="signatures per batch (fixed — one warm shape)")
+    ap.add_argument("--capacity-sigs", type=int, default=48,
+                    help="small on purpose: overload must actually occur")
+    ap.add_argument("--mesh", type=int, default=0)
+    ap.add_argument("--storm", default="mixed",
+                    choices=["none", "stall", "death", "error",
+                             "deadline", "mixed"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    rnd = random.Random(args.seed)
+    keys = [SigningKey.new(rnd) for _ in range(16)]
+    site = faults.SITE_SHARDED if args.mesh and args.mesh > 1 \
+        else faults.SITE_LANE
+    warm_vs, _ = make_pool(random.Random(args.seed ^ 0xA), keys,
+                           1, args.sigs)
+    warm_shapes(warm_vs[0], chunk=8, mesh=args.mesh)
+
+    violations = 0
+    t_begin = time.time()
+    totals = {"rounds": 0, "batches": 0, "verdicts": 0, "overloaded": 0,
+              "deadline": 0, "closed": 0, "injected": 0}
+    for r in range(args.rounds):
+        round_seed = rnd.getrandbits(32)
+        ok, rec = run_round(r, round_seed, args, keys, site)
+        violations += not ok
+        totals["rounds"] += 1
+        totals["batches"] += args.submitters * args.requests
+        for k in ("verdicts", "overloaded", "deadline", "closed",
+                  "injected"):
+            totals[k] += rec[k]
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            print(f"round {r:2d} seed={round_seed:#010x} "
+                  f"inj={rec['injected']:3d} verdicts={rec['verdicts']:2d} "
+                  f"ovl={rec['overloaded']:2d} dl={rec['deadline']:2d} "
+                  f"breaker={rec['breaker']:9s} "
+                  f"{'OK' if ok else 'VIOLATION'}")
+    dt = time.time() - t_begin
+    if args.storm in ("stall", "death", "error", "mixed") \
+            and totals["injected"] == 0:
+        # A device-fault storm that never injected tested nothing — a
+        # soak must not print a false green on the acceptance bar.
+        print(f"VIOLATION: storm {args.storm!r} injected 0 faults over "
+              f"{totals['rounds']} rounds (site mismatch or device "
+              f"never dispatched?)", file=sys.stderr)
+        violations += 1
+    summary = {
+        "ok": violations == 0, "violations": violations,
+        "seconds": round(dt, 2), "storm": args.storm,
+        "fault_counters": metrics.fault_counters(),
+        "gauges": metrics.gauges(), **totals,
+    }
+    print("LOAD_SOAK", json.dumps(summary))
+    sys.stdout.flush()  # os._exit skips buffer flushing (piped CI logs)
+    # exit like bench.py/chaos_soak.py: never risk native teardown with a
+    # parked lane worker (stall storms abandon workers by design)
+    batch._DeviceLane.reset_all(timeout=30.0)
+    os._exit(0 if violations == 0 else 1)
+
+
+if __name__ == "__main__":
+    main()
