@@ -1,0 +1,28 @@
+"""Consensus-safety static analysis (tools/consensuslint.py front end).
+
+The package's consensus-grade claims rest on invariants that used to
+live only in prose (docs/failure-model.md) and reviewers' heads:
+integer-only device math, injected clocks, centralized env knobs, no
+iteration-order-dependent verdict aggregation, secret hygiene.  This
+subpackage machine-checks them on every commit, in three layers:
+
+* **Layer 1 — AST linter** (`linter.py`): the numbered invariant
+  catalog CL001–CL006 over the package's syntax trees, with an
+  explicit, justified waiver file (`waivers.toml`).
+* **Layer 2 — IR audit** (`ir_audit.py`): trace the jitted device MSM
+  and every selectable Pallas kernel variant in interpret mode, walk
+  the jaxprs, and hold them to a committed primitive manifest
+  (`jaxpr_manifest.json`) — integer-only dtypes, no denylisted
+  primitives, stable collective order in the sharded path.
+* **Layer 3 — lock-order verification** (`lockorder.py`): an
+  instrumented `threading` layer that records the lock-acquisition
+  graph across the threaded test suites and fails on cycles, turning
+  the package's lock hierarchy into a checked partial order.
+
+The full catalog, the derived lock hierarchy, and the waiver policy are
+documented in docs/consensus-invariants.md.
+"""
+
+from . import linter  # noqa: F401  (the rule catalog is the public face)
+
+__all__ = ["linter"]

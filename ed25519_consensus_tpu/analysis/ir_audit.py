@@ -1,0 +1,337 @@
+"""Layer 2 of the consensus-safety static analysis: the jaxpr audit.
+
+The AST linter (CL001) keeps float SYNTAX out of the consensus path,
+but the property the paper actually needs is a property of the traced
+program: the device MSM that feeds a verdict must lower to INTEGER-ONLY
+arithmetic with no nondeterministic primitives and — in the sharded
+path — a stable collective schedule, because Edwards-group partial sums
+are only reduction-order-independent when every lane computes exact
+integers.  This module traces the jitted device MSM and every
+SELECTABLE Pallas kernel variant (interpret mode, shrunken tile — the
+same idiom as tools/interp_parity_case.py), walks the jaxprs
+recursively (scan/pjit/pallas_call/shard_map/cond bodies included), and
+asserts:
+
+* every array in every (sub)jaxpr has an integer/bool dtype — no
+  float16/32/64, no bfloat16, no complex;
+* no denylisted primitive appears (RNG and precision-mutating
+  primitives have no business in a verification kernel);
+* the sharded path's collectives, in equation order, match the
+  committed schedule exactly (a silently reordered or added collective
+  is how cross-chip nondeterminism ships);
+* the whole primitive surface matches the committed manifest
+  (`jaxpr_manifest.json`) — ANY drift fails with a diff, so a kernel
+  change must regenerate the manifest in the same commit
+  (`tools/consensuslint.py --ir-audit --write-manifest`) and the
+  reviewer sees the IR-level diff alongside the source diff.
+
+Audited variants (the four selectable kernel-variant combinations plus
+the XLA scan kernel and the sharded mesh kernel):
+
+* ``xla-kernel-many``   — the XLA scan kernel batched dispatch
+  (production wires: packed digits, compressed points).
+* ``pallas-rolled``     — the default Mosaic body (fori_loop).
+* ``pallas-hybrid``     — ED25519_TPU_PALLAS_BODY=hybrid.
+* ``pallas-tbl-int32``  — the tbl_dtype=int32 VMEM-overflow escape.
+* ``pallas-win-chunk3`` — a non-default ED25519_TPU_WIN_CHUNK.
+* ``sharded-mesh2``     — the shard_map'd mesh kernel (requires ≥ 2
+  devices; CI runs it on the 8-virtual-device CPU backend).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .linter import MANIFEST_PATH
+
+# Primitives that must never appear in a verification kernel: random
+# bits (a verdict must be a pure function of its inputs) and precision
+# mutation (silently changes the arithmetic the parity tests pinned).
+DENYLIST_SUBSTRINGS = ("rng_", "random_", "reduce_precision",
+                       "stochastic")
+
+# The collective vocabulary for the stable-order check.
+COLLECTIVE_PRIMITIVES = frozenset((
+    "all_gather", "psum", "all_to_all", "ppermute", "reduce_scatter",
+    "pmax", "pmin", "axis_index",
+))
+
+
+def _jaxpr_types():
+    """(ClosedJaxpr, Jaxpr) across jax versions: jax.extend.core is the
+    supported home from ~0.5 on (the jax.core aliases are removed in
+    0.6); fall back for the 0.4.x line the image ships."""
+    try:
+        from jax.extend import core as jcore
+        return jcore.ClosedJaxpr, jcore.Jaxpr
+    except ImportError:
+        from jax import core as jcore
+        return jcore.ClosedJaxpr, jcore.Jaxpr
+
+
+def _subjaxprs(params: dict):
+    """Every nested jaxpr hiding in an eqn's params (scan/pjit/
+    pallas_call/shard_map jaxpr, cond branches, ...)."""
+    ClosedJaxpr, Jaxpr = _jaxpr_types()
+
+    def visit(v):
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from visit(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                yield from visit(x)
+
+    for v in params.values():
+        yield from visit(v)
+
+
+def walk_jaxpr(jaxpr):
+    """Yield every equation of a jaxpr and its nested sub-jaxprs, in
+    program order (outer first, each eqn before its body)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from walk_jaxpr(sub)
+
+
+def _aval_dtypes(jaxpr, out: set):
+    for v in list(jaxpr.invars) + list(jaxpr.constvars) \
+            + list(jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "dtype"):
+            out.add(str(aval.dtype))
+    for eqn in jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                out.add(str(aval.dtype))
+        for sub in _subjaxprs(eqn.params):
+            _aval_dtypes(sub, out)
+    return out
+
+
+def summarize(closed) -> dict:
+    """The manifest entry for one traced variant: sorted primitive
+    names, sorted dtype names, and the collectives in equation order."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    prims = set()
+    collectives = []
+    for eqn in walk_jaxpr(jaxpr):
+        name = eqn.primitive.name
+        prims.add(name)
+        if name in COLLECTIVE_PRIMITIVES:
+            collectives.append(name)
+    dtypes = _aval_dtypes(jaxpr, set())
+    return {
+        "primitives": sorted(prims),
+        "dtypes": sorted(dtypes),
+        "collectives": collectives,
+    }
+
+
+def audit_summary(name: str, summary: dict) -> "list[str]":
+    """The invariant checks that hold regardless of the manifest:
+    integer-only dtypes and a clean denylist."""
+    problems = []
+    for dt in summary["dtypes"]:
+        if dt.startswith(("float", "bfloat", "complex")):
+            problems.append(
+                f"{name}: non-integer dtype {dt!r} in the traced "
+                f"kernel — the consensus MSM is integer-only by "
+                f"construction")
+    for p in summary["primitives"]:
+        for bad in DENYLIST_SUBSTRINGS:
+            if bad in p:
+                problems.append(
+                    f"{name}: denylisted primitive {p!r}")
+    return problems
+
+
+def audit_fn(name: str, fn, *args) -> "tuple[dict, list[str]]":
+    """Trace `fn(*args)` with make_jaxpr and run the manifest-free
+    checks; returns (summary, problems)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    summary = summarize(closed)
+    return summary, audit_summary(name, summary)
+
+
+# -- the audited kernel variants -------------------------------------------
+
+_B = 2          # batch axis of the batched dispatches
+_N = 256        # lanes: 2 shrunken-tile grid blocks for the Pallas body
+_TILE = (1, 128)  # interpret-mode tile (tools/interp_parity_case.py)
+
+
+def _operands(n_batches=_B, n_lanes=_N):
+    """Production-wire operands: nibble-packed digit planes (uint8) and
+    compressed points (33 rows: 32 encoding bytes + hint byte).  Zero
+    digits on identity-shaped encodings — tracing only reads shapes and
+    dtypes, never values."""
+    from ..ops import limbs
+
+    digits = np.zeros((n_batches, limbs.PACKED_WINDOWS, n_lanes),
+                      dtype=np.uint8)
+    pts = np.zeros((n_batches, 33, n_lanes), dtype=np.uint8)
+    pts[:, 0, :] = 1  # y = 1 little-endian: low encoding byte is 1
+    return digits, pts
+
+
+def trace_variants(include_sharded: "bool | None" = None) -> dict:
+    """name -> (callable, args) for every audited variant.  `sharded`
+    is included iff the backend exposes ≥ 2 devices (None = auto)."""
+    import jax
+
+    from ..ops import msm, pallas_msm
+    from ..ops.limbs import NWINDOWS
+
+    digits, pts = _operands()
+    variants = {
+        "xla-kernel-many": (
+            msm._compiled_kernel_many.__wrapped__(
+                _B, _N, NWINDOWS, wire="compressed", dwire="packed"),
+            (digits, pts)),
+    }
+    for name, kwargs in (
+            ("pallas-rolled", dict(body="rolled", win_chunk=11)),
+            ("pallas-hybrid", dict(body="hybrid", win_chunk=3)),
+            ("pallas-tbl-int32", dict(body="rolled", tbl_dtype="int32",
+                                      win_chunk=11)),
+            ("pallas-win-chunk3", dict(body="rolled", win_chunk=3)),
+    ):
+        variants[name] = (
+            pallas_msm._compiled_pipeline.__wrapped__(
+                _B, _N, NWINDOWS, interpret=True, tile=_TILE,
+                wire="compressed", dwire="packed",
+                **kwargs),
+            (digits, pts))
+    if include_sharded is None:
+        include_sharded = jax.device_count() >= 2
+    if include_sharded:
+        from ..parallel import sharded_msm
+
+        variants["sharded-mesh2"] = (
+            sharded_msm._compiled_sharded_kernel_many(
+                2, _B, _N // 2, NWINDOWS, wire="compressed",
+                dwire="packed"),
+            (digits, pts))
+    return variants
+
+
+def build_manifest(include_sharded: "bool | None" = None
+                   ) -> "tuple[dict, list[str]]":
+    """Trace every variant; returns (manifest, problems) where problems
+    are the manifest-free invariant violations."""
+    import jax
+
+    manifest = {"jax_version": jax.__version__, "variants": {}}
+    problems = []
+    for name, (fn, args) in trace_variants(include_sharded).items():
+        summary, probs = audit_fn(name, fn, *args)
+        manifest["variants"][name] = summary
+        problems.extend(probs)
+    # The sharded path must actually use a stable collective schedule:
+    # exactly one all_gather (the ICI all-reduce of partial window
+    # sums), nothing else, in that order.
+    sh = manifest["variants"].get("sharded-mesh2")
+    if sh is not None and sh["collectives"] != ["all_gather"]:
+        problems.append(
+            f"sharded-mesh2: collective schedule {sh['collectives']} "
+            f"!= ['all_gather'] — the mesh path's one-collective "
+            f"contract changed")
+    return manifest, problems
+
+
+def diff_manifests(committed: dict, current: dict) -> "list[str]":
+    """Human-readable drift between the committed manifest and the
+    freshly traced one.  Variants missing on either side count; a
+    variant the current backend cannot trace (sharded on a 1-device
+    host) is skipped rather than reported."""
+    out = []
+    cv, nv = committed.get("variants", {}), current.get("variants", {})
+    for name in sorted(set(cv) | set(nv)):
+        if name not in nv:
+            continue  # untraceable here (e.g. sharded on 1 device)
+        if name not in cv:
+            out.append(f"{name}: not in committed manifest (regenerate "
+                       f"with --write-manifest)")
+            continue
+        for field in ("primitives", "dtypes", "collectives"):
+            old, new = cv[name].get(field, []), nv[name].get(field, [])
+            if old != new:
+                gone = [x for x in old if x not in new]
+                added = [x for x in new if x not in old]
+                if field == "collectives" and sorted(old) == sorted(new):
+                    out.append(f"{name}.{field}: ORDER changed "
+                               f"{old} -> {new}")
+                else:
+                    out.append(
+                        f"{name}.{field}: drift"
+                        + (f" +{added}" if added else "")
+                        + (f" -{gone}" if gone else ""))
+    return out
+
+
+def load_manifest(path: str = MANIFEST_PATH) -> "dict | None":
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_manifest(manifest: dict, path: str = MANIFEST_PATH) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def main(write: bool = False) -> int:
+    manifest, problems = build_manifest()
+    for p in problems:
+        print(f"ir-audit: INVARIANT: {p}")
+    if write:
+        if problems:
+            print("ir-audit: refusing to write a manifest that violates "
+                  "the audit invariants")
+            return 1
+        # Variants the current backend cannot trace (sharded-mesh2 on a
+        # 1-device host) keep their COMMITTED entries: regenerating on
+        # a laptop must not silently drop the sharded-path audit that
+        # CI's 8-virtual-device run still enforces.
+        prior = load_manifest() or {"variants": {}}
+        for name, entry in prior["variants"].items():
+            if name not in manifest["variants"]:
+                manifest["variants"][name] = entry
+                print(f"ir-audit: kept committed entry for {name!r} "
+                      f"(not traceable on this backend)")
+        write_manifest(manifest)
+        print(f"ir-audit: wrote {MANIFEST_PATH} "
+              f"({len(manifest['variants'])} variants)")
+        return 0
+    committed = load_manifest()
+    if committed is None:
+        print("ir-audit: no committed manifest "
+              "(run --ir-audit --write-manifest once)")
+        return 1
+    drift = diff_manifests(committed, manifest)
+    for d in drift:
+        print(f"ir-audit: DRIFT: {d}")
+    traced = sorted(manifest["variants"])
+    if problems or drift:
+        return 1
+    print(f"ir-audit: clean — {len(traced)} variants traced "
+          f"({', '.join(traced)}), manifest matched")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(write="--write-manifest" in sys.argv))

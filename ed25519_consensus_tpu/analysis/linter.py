@@ -1,0 +1,675 @@
+"""consensuslint — the AST layer of the consensus-safety static analysis.
+
+A small rule engine over the package's syntax trees enforcing the
+numbered invariant catalog (docs/consensus-invariants.md):
+
+* **CL001 float-free consensus path** — no float literals and no float
+  dtypes in the modules whose arithmetic feeds a verdict (`ops/`,
+  `parallel/`) or in batch.py's verdict-path symbols.  The ZIP215
+  accept/reject decision must be exact integer math end to end.
+* **CL002 injected clocks only** — no raw `time.time`/`time.monotonic`
+  calls anywhere outside `health.Clock`.  Wall-clock reads hidden in
+  scheduler code are exactly what made the pre-round-6 tests
+  load-sensitive; every timestamp goes through an injectable Clock.
+* **CL003 central knob registry** — no raw `os.environ`/`os.getenv`
+  reads outside `config.py`.  Every ED25519_TPU_* knob is declared,
+  typed, and validated in one place.
+* **CL004 no new module-global mutable state** in the scheduler/
+  service modules (batch/service/health/routing/faults) — the
+  regression guard for the PR-2 DeviceHealth cleanup.  Locks are
+  recognized structurally; the existing caches/registries are an
+  explicit in-catalog allowlist, so ADDING one is a lint failure that
+  forces a review.
+* **CL005 secret hygiene** — in signing_key.py, the secret scalar `s`,
+  the `prefix`, and the serialized secret bytes must not be reachable
+  from `__repr__`/`__str__`/f-strings/`print`/logging calls.
+* **CL006 verdict-path discipline** in batch.py/service.py — no bare
+  or overbroad `except`, and no verdict aggregation driven by dict/set
+  iteration order (the shape of the old `verify_single_many`
+  poison-entry map surgery).
+
+Findings are `(rule, path, line, symbol, message)`; a committed waiver
+(`waivers.toml`) may suppress a finding by (rule, path, symbol) with a
+mandatory one-line justification.  Unused waivers are themselves
+errors — the waiver file can never silently outlive the code it
+excused.
+"""
+
+import ast
+import hashlib
+import json
+import os
+
+__all__ = [
+    "Finding", "ParsedModule", "RULES", "RULE_IDS",
+    "iter_package_files", "lint_paths", "lint_package",
+    "load_waivers", "apply_waivers", "WaiverError", "stats",
+    "PACKAGE_ROOT", "REPO_ROOT", "WAIVERS_PATH", "MANIFEST_PATH",
+]
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+WAIVERS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "waivers.toml")
+MANIFEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "jaxpr_manifest.json")
+
+RULE_IDS = ("CL001", "CL002", "CL003", "CL004", "CL005", "CL006")
+
+# CL001 scope inside batch.py: the symbols on the verdict path (staging,
+# exact verification, the union/bisection machinery).  The scheduler
+# half of batch.py legitimately holds float timeouts/EMAs.
+_CL001_BATCH_SYMBOLS = (
+    "Item.verify_single", "StagedBatch", "Verifier._stage",
+    "Verifier._stage_queue_order", "Verifier._stage_grouped",
+    "challenge_int", "merge_verifiers", "_host_verdict",
+    "_resolve_union", "verify_single_many", "PendingVerification",
+)
+
+_FLOAT_DTYPES = frozenset(
+    ("float16", "float32", "float64", "bfloat16", "float_"))
+
+# CL004: the scheduler/service modules under the module-global freeze,
+# and the module-level mutable names that predate the rule (caches and
+# registries reviewed in PRs 2-4).  Adding a name here is a reviewed
+# act; adding a global without adding it here fails the lint.
+_CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
+                  "faults.py")
+_CL004_ALLOWED = {
+    "batch.py": frozenset((
+        "_shift128_cache", "_key_row_cache", "_host_split_cache",
+        "_seen_keys", "_keyset_blob_cache", "last_run_stats",
+        "_HEALTH_FIELD_SHIMS",
+    )),
+    "service.py": frozenset(("_BREAKER_GAUGE",)),
+    "health.py": frozenset(("_lane_stuck_latch", "_registry")),
+    "routing.py": frozenset(("_device_count", "_default")),
+    "faults.py": frozenset(("_active",)),
+}
+_LOCK_CONSTRUCTORS = frozenset(
+    ("Lock", "RLock", "Condition", "Event", "Semaphore",
+     "BoundedSemaphore", "Barrier"))
+
+_CL006_MODULES = ("batch.py", "service.py")
+_CL005_SECRET_ATTRS = frozenset(("s", "prefix"))
+_CL005_SECRET_CALLS = frozenset(("to_bytes", "__bytes__"))
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "symbol", "message")
+
+    def __init__(self, rule, path, line, col, symbol, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.symbol = symbol
+        self.message = message
+
+    def key(self):
+        """The waiver-matching identity: (rule, path, symbol)."""
+        return (self.rule, self.path, self.symbol)
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message}
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.symbol}] {self.message}")
+
+    def __repr__(self):
+        return f"Finding({self})"
+
+
+class ParsedModule:
+    """One parsed source file plus the lookup tables the rules share:
+    enclosing-symbol qualnames per node and the module's import
+    aliases for `time` and `os`."""
+
+    def __init__(self, path: str, source: str, relpath: "str | None" = None):
+        self.path = path
+        self.relpath = relpath if relpath is not None else _relpath(path)
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self._symbol = {}
+        self._parent = {}
+        self.time_aliases = set()
+        self.os_aliases = set()
+        self.time_func_aliases = set()   # from time import monotonic, time
+        self.environ_aliases = set()     # from os import environ/getenv
+        self._index(self.tree, "<module>")
+
+    def _index(self, node, symbol):
+        for child in ast.iter_child_nodes(node):
+            self._parent[id(child)] = node
+            child_symbol = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                child_symbol = (child.name if symbol == "<module>"
+                                else f"{symbol}.{child.name}")
+            self._symbol[id(child)] = child_symbol
+            if isinstance(child, ast.Import):
+                for a in child.names:
+                    if a.name == "time":
+                        self.time_aliases.add(a.asname or a.name)
+                    if a.name == "os":
+                        self.os_aliases.add(a.asname or a.name)
+            elif isinstance(child, ast.ImportFrom):
+                if child.module == "time":
+                    for a in child.names:
+                        if a.name in ("time", "monotonic"):
+                            self.time_func_aliases.add(a.asname or a.name)
+                elif child.module == "os":
+                    for a in child.names:
+                        if a.name in ("environ", "getenv"):
+                            self.environ_aliases.add(a.asname or a.name)
+            self._index(child, child_symbol)
+
+    def symbol_of(self, node) -> str:
+        """Innermost enclosing class/function qualname (the waiver
+        anchor), or "<module>" at top level.  For a def/class node
+        itself this is the ENCLOSING symbol, matching 'where was this
+        added'."""
+        return self._symbol.get(id(node), "<module>")
+
+    def parent_of(self, node):
+        return self._parent.get(id(node))
+
+    def walk(self):
+        return ast.walk(self.tree)
+
+
+def _relpath(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(REPO_ROOT + os.sep):
+        return os.path.relpath(ap, REPO_ROOT).replace(os.sep, "/")
+    return os.path.basename(path)
+
+
+def _pkg_rel(relpath: str) -> str:
+    """Path relative to the package dir ('' prefix stripped), so rule
+    scopes read naturally ("ops/", "batch.py")."""
+    prefix = "ed25519_consensus_tpu/"
+    return relpath[len(prefix):] if relpath.startswith(prefix) else relpath
+
+
+# -- rule implementations --------------------------------------------------
+
+
+def _is_float_dtype_attr(node) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr in _FLOAT_DTYPES
+
+
+def _check_cl001(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    in_scope_module = rel.startswith("ops/") or rel.startswith("parallel/")
+    is_batch = rel == "batch.py"
+    if not (in_scope_module or is_batch):
+        return
+
+    def scoped(node) -> bool:
+        if in_scope_module:
+            return True
+        sym = mod.symbol_of(node)
+        return any(sym == s or sym.startswith(s + ".")
+                   for s in _CL001_BATCH_SYMBOLS)
+
+    for node in mod.walk():
+        if not scoped(node):
+            continue
+        if isinstance(node, ast.Constant) and type(node.value) is float:
+            yield Finding(
+                "CL001", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"float literal {node.value!r} in consensus-path code "
+                f"(the verdict path is exact integer math)")
+        elif _is_float_dtype_attr(node):
+            yield Finding(
+                "CL001", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"float dtype `{node.attr}` in consensus-path code")
+        elif (isinstance(node, ast.Constant)
+              and isinstance(node.value, str)
+              and node.value in _FLOAT_DTYPES):
+            yield Finding(
+                "CL001", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"float dtype string {node.value!r} in consensus-path "
+                f"code")
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "astype"
+              and any(isinstance(a, ast.Name) and a.id == "float"
+                      for a in node.args)):
+            yield Finding(
+                "CL001", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                "astype(float) in consensus-path code")
+
+
+def _check_cl002(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    if rel == "health.py":
+        return  # the one sanctioned home of the raw clock (health.Clock)
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        bad = None
+        if (isinstance(f, ast.Attribute)
+                and f.attr in ("time", "monotonic")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in mod.time_aliases):
+            bad = f"{f.value.id}.{f.attr}"
+        elif (isinstance(f, ast.Name) and f.id in mod.time_func_aliases):
+            bad = f.id
+        if bad:
+            yield Finding(
+                "CL002", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"raw `{bad}()` call — all scheduler/service time must "
+                f"come from an injected health.Clock "
+                f"(health.SYSTEM_CLOCK.monotonic for wall time)")
+
+
+def _check_cl003(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    if rel == "config.py":
+        return  # THE sanctioned reader
+    for node in mod.walk():
+        if (isinstance(node, ast.Attribute)
+                and node.attr in ("environ", "getenv")
+                and isinstance(node.value, ast.Name)
+                and node.value.id in mod.os_aliases):
+            yield Finding(
+                "CL003", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"raw `os.{node.attr}` read — every ED25519_TPU_* knob "
+                f"goes through the config.py registry")
+        elif (isinstance(node, ast.Name)
+              and node.id in mod.environ_aliases
+              and isinstance(node.ctx, ast.Load)):
+            yield Finding(
+                "CL003", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"raw `{node.id}` (from os import) — use the config.py "
+                f"registry")
+
+
+def _is_lock_call(value) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return name in _LOCK_CONSTRUCTORS
+
+
+def _is_mutable_value(value) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+            and value.func.id in ("dict", "list", "set", "bytearray",
+                                  "deque", "defaultdict", "OrderedDict",
+                                  "Counter"):
+        return True
+    return False
+
+
+def _check_cl004(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    if rel not in _CL004_MODULES:
+        return
+    allowed = _CL004_ALLOWED.get(rel, frozenset())
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names or names == ["__all__"]:
+            continue
+        if _is_lock_call(value):
+            continue  # locks/conditions are the sanctioned global kind
+        if not _is_mutable_value(value):
+            continue
+        for name in names:
+            if name in allowed:
+                continue
+            yield Finding(
+                "CL004", mod.relpath, node.lineno, node.col_offset,
+                "<module>",
+                f"new module-global mutable state `{name}` in a "
+                f"scheduler/service module — use an injectable object "
+                f"(see health.DeviceHealth) or add it to the reviewed "
+                f"CL004 allowlist")
+
+
+def _references_secret(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _CL005_SECRET_ATTRS \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id == "self":
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _CL005_SECRET_CALLS:
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "bytes" and n.args \
+                and isinstance(n.args[0], ast.Name) \
+                and n.args[0].id == "self":
+            return True
+    return False
+
+
+def _check_cl005(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    if rel != "signing_key.py":
+        return
+    for node in mod.walk():
+        sym = mod.symbol_of(node)
+        in_repr = sym.rsplit(".", 1)[-1] in ("__repr__", "__str__",
+                                             "__format__")
+        if in_repr and (isinstance(node, (ast.JoinedStr, ast.Return))
+                        or (isinstance(node, ast.Call))):
+            if _references_secret(node):
+                yield Finding(
+                    "CL005", mod.relpath, node.lineno, node.col_offset,
+                    sym,
+                    "secret bytes reachable from __repr__/__str__ — "
+                    "SigningKey debug output must redact `s`, `prefix` "
+                    "and the serialized secret")
+                continue
+        if isinstance(node, ast.Call):
+            f = node.func
+            is_print = isinstance(f, ast.Name) and f.id == "print"
+            is_logging = (isinstance(f, ast.Attribute)
+                          and f.attr in ("debug", "info", "warning",
+                                         "error", "critical", "exception",
+                                         "log"))
+            if (is_print or is_logging) and _references_secret(node):
+                yield Finding(
+                    "CL005", mod.relpath, node.lineno, node.col_offset,
+                    sym,
+                    "secret bytes passed to print/logging in "
+                    "signing_key.py")
+
+
+_VERDICT_NAME = ("verdict", "verdicts", "result", "results")
+
+
+def _iter_is_unordered(it) -> "str | None":
+    """Why this For-iterable is dict/set-iteration-ordered, or None."""
+    if isinstance(it, ast.Set) or isinstance(it, ast.SetComp):
+        return "set display"
+    if isinstance(it, ast.Call):
+        f = it.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return f"{f.id}() call"
+        if isinstance(f, ast.Attribute) and f.attr in ("keys", "values",
+                                                       "items"):
+            return f".{f.attr}() dict view"
+    return None
+
+
+def _writes_verdict(body) -> "int | None":
+    """Line of the first statement in `body` that stores into a
+    verdict-named target (subscript assignment or .append), or None."""
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [
+                    n.target]
+                for t in targets:
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Name) \
+                            and base.id in _VERDICT_NAME:
+                        return n.lineno
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("append", "extend") \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id in _VERDICT_NAME:
+                return n.lineno
+    return None
+
+
+def _check_cl006(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    if rel not in _CL006_MODULES:
+        return
+    for node in mod.walk():
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                yield Finding(
+                    "CL006", mod.relpath, node.lineno, node.col_offset,
+                    mod.symbol_of(node),
+                    "bare `except:` on the verdict path — catch the "
+                    "specific error the ladder handles")
+            elif isinstance(node.type, ast.Name) \
+                    and node.type.id in ("Exception", "BaseException"):
+                yield Finding(
+                    "CL006", mod.relpath, node.lineno, node.col_offset,
+                    mod.symbol_of(node),
+                    f"overbroad `except {node.type.id}` on the verdict "
+                    f"path — narrow it or waive with the supervision "
+                    f"rationale")
+        elif isinstance(node, ast.For):
+            why = _iter_is_unordered(node.iter)
+            if why:
+                line = _writes_verdict(node.body)
+                if line is not None:
+                    yield Finding(
+                        "CL006", mod.relpath, node.lineno,
+                        node.col_offset, mod.symbol_of(node),
+                        f"verdict aggregation ordered by {why} — "
+                        f"verdicts must be keyed by submission order, "
+                        f"never by dict/set iteration order")
+
+
+RULES = {
+    "CL001": _check_cl001,
+    "CL002": _check_cl002,
+    "CL003": _check_cl003,
+    "CL004": _check_cl004,
+    "CL005": _check_cl005,
+    "CL006": _check_cl006,
+}
+
+
+# -- driver ----------------------------------------------------------------
+
+
+def iter_package_files(root: "str | None" = None):
+    """Every .py file of the package (sorted, deterministic)."""
+    root = root or PACKAGE_ROOT
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_module(mod: ParsedModule) -> "list[Finding]":
+    findings = []
+    for rule_id in RULE_IDS:
+        findings.extend(RULES[rule_id](mod) or ())
+    return findings
+
+
+def lint_paths(paths) -> "list[Finding]":
+    findings = []
+    for path in paths:
+        if os.path.isdir(path):
+            findings.extend(lint_paths(iter_package_files(path)))
+            continue
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_module(ParsedModule(path, source)))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_package() -> "list[Finding]":
+    return lint_paths([PACKAGE_ROOT])
+
+
+# -- waivers ---------------------------------------------------------------
+
+
+class WaiverError(ValueError):
+    """A malformed or unused waiver — both fail the lint run: the
+    waiver file must exactly excuse the findings that exist, no more."""
+
+
+def _parse_toml(text: str) -> dict:
+    """Parse the waiver file: stdlib tomllib on 3.11+, else a strict
+    subset parser (array-of-tables of string keys) — the build image
+    runs 3.10 and the waiver format deliberately fits the subset."""
+    try:
+        import tomllib
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        try:
+            return tomllib.loads(text)
+        except tomllib.TOMLDecodeError as e:
+            # Same typed failure as the subset parser below: the CLI
+            # (and load_soak's lint gate) turn WaiverError into a clean
+            # exit-2, never a raw traceback.
+            raise WaiverError(f"waivers.toml: {e}") from e
+    data: dict = {}
+    current = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            name = line[2:-2].strip()
+            current = {}
+            data.setdefault(name, []).append(current)
+            continue
+        if "=" in line and current is not None:
+            key, _, val = line.partition("=")
+            key = key.strip()
+            val = val.strip()
+            if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+                current[key] = val[1:-1]
+            else:
+                raise WaiverError(
+                    f"waivers.toml:{lineno}: only quoted string values "
+                    f"are supported ({raw.strip()!r})")
+            continue
+        raise WaiverError(f"waivers.toml:{lineno}: unparseable line "
+                          f"{raw.strip()!r}")
+    return data
+
+
+def load_waivers(path: "str | None" = None) -> "list[dict]":
+    path = path or WAIVERS_PATH
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = _parse_toml(f.read())
+    waivers = data.get("waiver", [])
+    for i, w in enumerate(waivers):
+        for field in ("rule", "path", "symbol", "reason"):
+            if not w.get(field):
+                raise WaiverError(
+                    f"waiver #{i + 1} is missing required field "
+                    f"{field!r} (every waiver carries a one-line "
+                    f"justification)")
+        if w["rule"] not in RULE_IDS:
+            raise WaiverError(
+                f"waiver #{i + 1} names unknown rule {w['rule']!r}")
+    return waivers
+
+
+def apply_waivers(findings, waivers):
+    """Split findings into (active, waived); raises WaiverError for any
+    waiver that matched nothing (stale waivers are errors)."""
+    used = [False] * len(waivers)
+    active, waived = [], []
+    for f in findings:
+        matched = False
+        for i, w in enumerate(waivers):
+            if (w["rule"], w["path"], w["symbol"]) == f.key():
+                used[i] = True
+                matched = True
+        (waived if matched else active).append(f)
+    stale = [w for i, w in enumerate(waivers) if not used[i]]
+    if stale:
+        desc = "; ".join(
+            f"{w['rule']} {w['path']} [{w['symbol']}]" for w in stale)
+        raise WaiverError(
+            f"stale waiver(s) matched no finding — delete them: {desc}")
+    return active, waived
+
+
+# -- stats (the soak-tooling surface) --------------------------------------
+
+
+def manifest_hash() -> "str | None":
+    """sha256 of the committed jaxpr primitive manifest, or None when
+    the manifest has not been generated yet."""
+    if not os.path.exists(MANIFEST_PATH):
+        return None
+    with open(MANIFEST_PATH, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def stats(findings=None, waivers=None) -> dict:
+    """Rule counts, waiver count, and the manifest hash — the numbers
+    `tools/consensuslint.py --stats` publishes into utils.metrics
+    gauges so soak tooling can assert the waiver count never silently
+    grows."""
+    if findings is None:
+        findings = lint_package()
+    if waivers is None:
+        waivers = load_waivers()
+    active, waived = apply_waivers(findings, waivers)
+    rule_counts = {rid: 0 for rid in RULE_IDS}
+    for f in findings:
+        rule_counts[f.rule] += 1
+    return {
+        "rule_counts": rule_counts,
+        "findings_total": len(findings),
+        "findings_active": len(active),
+        "findings_waived": len(waived),
+        "waiver_count": len(waivers),
+        "manifest_hash": manifest_hash(),
+    }
+
+
+def publish_gauges(st: "dict | None" = None) -> dict:
+    """Mirror `stats()` into the process-wide utils.metrics gauges:
+    consensuslint_waivers, consensuslint_findings_active, per-rule
+    consensuslint_<rule> counts, and jaxpr_manifest_hash."""
+    from ..utils import metrics
+
+    st = st if st is not None else stats()
+    metrics.set_gauge("consensuslint_waivers", st["waiver_count"])
+    metrics.set_gauge("consensuslint_findings_active",
+                      st["findings_active"])
+    metrics.set_gauge("consensuslint_findings_waived",
+                      st["findings_waived"])
+    for rid, n in st["rule_counts"].items():
+        metrics.set_gauge(f"consensuslint_{rid}", n)
+    metrics.set_gauge("jaxpr_manifest_hash", st["manifest_hash"])
+    return st
+
+
+def render_stats(st: dict) -> str:
+    return json.dumps(st, indent=2, sort_keys=True)

@@ -25,6 +25,7 @@ import threading
 
 import numpy as np
 
+from . import config as _config
 from .error import InvalidSignature, MalformedPublicKey
 from .ops import edwards, scalar
 from .signature import Signature
@@ -190,10 +191,7 @@ def _device_wire_mode() -> str:
     (ops/jnp_decompress.py); `affine` is the round-3 80 B/term X‖Y limb
     format, kept for A/B and as the fallback when staging captured no
     encodings."""
-    import os
-
-    v = os.environ.get("ED25519_TPU_WIRE", "compressed").lower()
-    return v if v in ("compressed", "affine") else "compressed"
+    return _config.get("ED25519_TPU_WIRE")
 
 
 def _device_digit_wire() -> str:
@@ -201,10 +199,7 @@ def _device_digit_wire() -> str:
     (default) ships two signed radix-16 digits per byte — 17 B/term
     instead of 33, unpacked in-jit (ops/msm.py expand_digits); `plain`
     is the one-digit-per-byte round-3 format."""
-    import os
-
-    v = os.environ.get("ED25519_TPU_DIGIT_WIRE", "packed").lower()
-    return v if v in ("packed", "plain") else "packed"
+    return _config.get("ED25519_TPU_DIGIT_WIRE")
 
 
 # Decompressed RAW key rows (canonical X‖Y‖Z‖T, 128 bytes) keyed by the
@@ -1170,9 +1165,11 @@ class _DeviceLane:
         sentinel, so handing it to the next `get()` would give that
         caller a worker that exits instead of serving submissions.
         Returns True when no worker remains alive."""
-        import time as _time
-
-        end = _time.monotonic() + timeout
+        # Teardown deadlines are real wall time by definition, but even
+        # they go through the health.Clock abstraction (consensuslint
+        # CL002: time.monotonic is read in exactly one place).
+        _mono = _health.SYSTEM_CLOCK.monotonic
+        end = _mono() + timeout
         with cls._instance_lock:
             lanes = list(cls._instances.items())
             abandoned = list(cls._abandoned_instances)
@@ -1182,8 +1179,7 @@ class _DeviceLane:
                 # floor of 50 ms even when an earlier lane ate the budget:
                 # a healthy idle worker joins in microseconds and should
                 # not be abandoned just because a sibling was stuck
-                inst.shutdown(
-                    timeout=max(0.05, end - _time.monotonic()))
+                inst.shutdown(timeout=max(0.05, end - _mono()))
             with cls._instance_lock:
                 if inst._thread.is_alive():
                     all_dead = False
@@ -1201,8 +1197,7 @@ class _DeviceLane:
                     del cls._instances[mode]
         for inst in abandoned:
             if inst._thread.is_alive():
-                inst.shutdown(
-                    timeout=max(0.05, end - _time.monotonic()))
+                inst.shutdown(timeout=max(0.05, end - _mono()))
             if inst._thread.is_alive():
                 all_dead = False
                 continue
@@ -1352,9 +1347,7 @@ class _DeviceLane:
                 # builds a fresh lane.
                 return
             except Exception:  # device error: caller decides on host
-                import os as _os
-
-                if _os.environ.get("ED25519_TPU_DEBUG"):
+                if _config.get("ED25519_TPU_DEBUG"):
                     import traceback
 
                     traceback.print_exc()
@@ -1633,9 +1626,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     EMA, host-lane medians — runs on that clock, which is what lets
     tests drive the failure machinery with health.FakeClock instead of
     wall-time bounds."""
-    import time as _time
-
     from .ops import msm
+
+    # Wall-clock for the per-call `seconds` stat only (scheduling time
+    # runs on the injected health clock; this is the one timestamp that
+    # deliberately measures REAL elapsed time for operators).
+    _wall = _health.SYSTEM_CLOCK.monotonic
 
     verifiers = list(verifiers)
     if merge not in ("auto", "never", "always"):
@@ -1651,7 +1647,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if len(groups) < len(verifiers):
             unions = [merge_verifiers([verifiers[i] for i in g])
                       for g in groups]
-            t0 = _time.monotonic()
+            t0 = _wall()
             # `mesh` passes through UNRESOLVED: when it is None (auto),
             # the recursive union-level call resolves routing on the
             # MERGED batch sizes — the ones actually dispatched.
@@ -1677,7 +1673,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 merged_unions=len(groups),
                 host_unions=stats.pop("host_batches", 0),
                 device_unions=stats.pop("device_batches", 0),
-                seconds=_time.monotonic() - t0,
+                seconds=_wall() - t0,
             )
             last_run_stats.clear()
             last_run_stats.update(stats)
@@ -1703,7 +1699,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
 
     verdicts = [False] * len(verifiers)
     remaining = list(range(len(verifiers)))  # tail = host-lane candidates
-    _t_begin = _time.monotonic()
+    _t_begin = _wall()
     stats = {
         "batches": len(verifiers),
         "sigs": sum(v.batch_size for v in verifiers),
@@ -1725,7 +1721,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     }
 
     def _finish(result):
-        stats["seconds"] = _time.monotonic() - _t_begin
+        stats["seconds"] = _wall() - _t_begin
         # Device PARTICIPATION, not wins: host-re-decided rejects count —
         # a device correctly rejecting an invalid-spam stream completed
         # its chunks and is working, and must not measure as
@@ -1841,11 +1837,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # sick: its batches are re-verified on the host — identical exact math
     # decides the verdict either way — and later calls skip the device
     # for a cooldown period.
-    import os as _os
-
-    if (_os.environ.get("ED25519_TPU_DISABLE_DEVICE", "").lower()
-            in ("1", "true", "yes")  # explicit opt-outs only, like
-            #                          ED25519_TPU_DISABLE_NATIVE
+    if (_config.get("ED25519_TPU_DISABLE_DEVICE")  # explicit opt-outs
+            #       only (config.py `opt-in` type), like DISABLE_NATIVE
             or not health.device_allowed()):
         # ED25519_TPU_DISABLE_DEVICE: config knob (SURVEY.md §5) forcing
         # the pure-host lane — also keeps jax entirely unloaded, which on
@@ -1861,12 +1854,10 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # budget is 3×EMA×batches (2 s floor).  The default fits real TPU
     # call times; ED25519_TPU_EMA_PRIOR overrides for legitimately slow
     # lanes (e.g. the virtual CPU mesh in dry runs, where a sharded call
-    # can take tens of seconds without being sick).
-    try:
-        ema_per_batch = float(
-            _os.environ.get("ED25519_TPU_EMA_PRIOR", "") or 0.2)
-    except ValueError:
-        ema_per_batch = 0.2
+    # can take tens of seconds without being sick).  A malformed value
+    # raises config.ConfigError here (registry contract) instead of
+    # silently running with the default prior.
+    ema_per_batch = _config.get("ED25519_TPU_EMA_PRIOR")
     ema_is_prior = True
     outstanding = []  # [(chunk_id, real idxs, t_submit, padded batches)]
     device_sick = False

@@ -1,0 +1,202 @@
+"""Central registry of every ``ED25519_TPU_*`` environment knob.
+
+Until this module existed the package's configuration surface was 13
+scattered ``os.environ`` reads with 13 slightly different parsing
+conventions — including an unvalidated ``float(...)`` in the routing
+path whose failure mode was a bare ``ValueError`` deep inside
+``verify_many``.  This registry is the single place the environment is
+read (consensuslint rule CL003 enforces that: a raw ``os.environ`` read
+anywhere else in the package is a lint failure), and every knob gets:
+
+* a declared TYPE with one parsing convention per type,
+* a DEFAULT,
+* its allowed values (choice knobs), and
+* a one-line doc string (the README knob table is generated from the
+  same entries, so the docs cannot drift from the code).
+
+Malformed values for numeric knobs raise :class:`ConfigError` (a typed
+``error.Error``) AT READ TIME with the knob name, the raw value, and
+what was expected.  Choice knobs keep their historical
+fall-back-to-default semantics where that behavior is documented API
+(e.g. ``ED25519_TPU_PALLAS_BODY=unrolled`` must fall back to ``rolled``
+— the unrolled body was removed in round 4).
+
+Reads are LIVE: nothing is cached here, so tests may monkeypatch
+``os.environ`` freely and long-running processes can flip opt-out knobs
+mid-flight (the contract ``ED25519_TPU_DISABLE_DEVICE`` has always
+had).  This module must stay importable with neither jax nor numpy
+installed — it is imported by ``native/`` on the no-accelerator path
+(tests/test_no_jax.py).
+
+Knob type conventions:
+
+* ``choice``  — lowercased and matched against ``choices``; anything
+  else falls back to the default (documented legacy semantics).
+* ``opt-in``  — boolean, default False; ONLY ``1``/``true``/``yes``
+  enable it (``ED25519_TPU_DISABLE_NATIVE=false`` must not disable).
+* ``opt-out`` — boolean, default True; ONLY ``0``/``false``/``no``
+  disable it.
+* ``flag``    — boolean, default False; ANY non-empty value enables it
+  (debug conveniences).
+* ``float`` / ``int`` — parsed strictly; empty/unset means the
+  default; malformed raises :class:`ConfigError`.
+* ``path``    — raw string; unset returns the default, an explicitly
+  empty value returns ``""`` (some knobs treat "" as an opt-out).
+"""
+
+import os
+
+from .error import ConfigError
+
+__all__ = ["ConfigError", "Knob", "KNOBS", "get", "get_raw",
+           "validate_all", "knob_table"]
+
+_OPT_IN_TRUE = ("1", "true", "yes")
+_OPT_OUT_FALSE = ("0", "false", "no")
+_TYPES = ("choice", "opt-in", "opt-out", "flag", "float", "int", "path")
+
+
+class Knob:
+    """One registered environment knob: name, type, default, allowed
+    values, and a one-line doc (the README table row)."""
+
+    __slots__ = ("name", "type", "default", "choices", "doc")
+
+    def __init__(self, name: str, type: str, default, doc: str,
+                 choices: "tuple | None" = None):
+        if type not in _TYPES:
+            raise ValueError(f"unknown knob type {type!r}")
+        self.name = name
+        self.type = type
+        self.default = default
+        self.choices = choices
+        self.doc = doc
+
+    def read(self):
+        """Parse the knob's CURRENT environment value (live read; unset
+        or empty generally means the default).  Raises ConfigError on a
+        malformed value for the strictly-parsed types."""
+        raw = os.environ.get(self.name)
+        if self.type == "choice":
+            v = (raw or "").lower()
+            return v if v in self.choices else self.default
+        if self.type == "opt-in":
+            return (raw or "").lower() in _OPT_IN_TRUE
+        if self.type == "opt-out":
+            return (raw or "").lower() not in _OPT_OUT_FALSE
+        if self.type == "flag":
+            return bool(raw)
+        if self.type == "path":
+            return self.default if raw is None else raw
+        if not raw:
+            return self.default
+        try:
+            return float(raw) if self.type == "float" else int(raw)
+        except ValueError:
+            raise ConfigError(self.name, raw,
+                              f"a {self.type}" + (
+                                  "" if self.default is None
+                                  else f" (default {self.default})"))
+
+    def __repr__(self):
+        return (f"Knob(name={self.name!r}, type={self.type!r}, "
+                f"default={self.default!r}, choices={self.choices!r})")
+
+
+def _k(name, type, default, doc, choices=None):
+    return name, Knob(name, type, default, doc, choices)
+
+
+# THE configuration surface (SURVEY.md §5).  Every entry corresponds to
+# exactly the historical reader semantics at its former call site; the
+# knob table in README.md renders these same entries.
+KNOBS: "dict[str, Knob]" = dict([
+    _k("ED25519_TPU_WIRE", "choice", "compressed",
+       "Device point wire: `compressed` (33 B/term, on-device ZIP215 "
+       "x-recompute) or `affine` (80 B/term X‖Y limbs).",
+       ("compressed", "affine")),
+    _k("ED25519_TPU_DIGIT_WIRE", "choice", "packed",
+       "Scalar digit wire: `packed` (two signed radix-16 digits/byte, "
+       "17 B/term, in-jit unpack) or `plain` (one digit/byte).",
+       ("packed", "plain")),
+    _k("ED25519_TPU_DEBUG", "flag", False,
+       "Any non-empty value prints device-lane tracebacks instead of "
+       "silently falling back to the host path."),
+    _k("ED25519_TPU_DISABLE_DEVICE", "opt-in", False,
+       "Force the pure-host lane and keep jax entirely unloaded "
+       "(re-checked live on every call)."),
+    _k("ED25519_TPU_DISABLE_NATIVE", "opt-in", False,
+       "Skip the native C++ extension; every caller has an "
+       "exact-Python fallback (re-checked live on every load())."),
+    _k("ED25519_TPU_EMA_PRIOR", "float", 0.2,
+       "Seconds-per-batch device turnaround prior before the first "
+       "measurement (deadline budget is 3×EMA×batches, 2 s floor)."),
+    _k("ED25519_TPU_MESH_FIXED_COST", "float", None,
+       "Override the N* crossover model's per-call fixed cost `a` "
+       "(seconds) after re-running the scaling lab on new hardware."),
+    _k("ED25519_TPU_MESH_PER_TERM", "float", None,
+       "Override the N* crossover model's on-chip per-term cost `b` "
+       "(seconds/term)."),
+    _k("ED25519_TPU_AUTO_MESH", "opt-out", True,
+       "Set to 0/false/no to disable N*-crossover mesh auto-selection "
+       "(auto then always resolves to the single-device lane)."),
+    _k("ED25519_TPU_PALLAS_BODY", "choice", "rolled",
+       "Pallas kernel body: `rolled` (fori_loops, seconds of trace) or "
+       "`hybrid` (unrolled windows); the removed `unrolled` body "
+       "falls back to `rolled`.",
+       ("rolled", "hybrid")),
+    _k("ED25519_TPU_WIN_CHUNK", "int", None,
+       "Windows per Pallas grid step; must be a positive divisor of "
+       "the window count (a non-divisor is warned about and ignored "
+       "at the dispatch site)."),
+    _k("ED25519_TPU_JAX_CACHE_DIR", "path", None,
+       "jax persistent compilation cache directory (accelerator "
+       "backends only); set to an empty string to opt out."),
+    _k("ED25519_TPU_MSM_KERNEL", "choice", "auto",
+       "Device kernel selection: `pallas` (Mosaic), `xla` (scan "
+       "kernel), or `auto` (Pallas on real TPU backends).",
+       ("auto", "pallas", "xla")),
+])
+
+
+def get(name: str):
+    """Parsed, validated value of a registered knob (live env read).
+    Raises KeyError for an unregistered name and ConfigError for a
+    malformed value of a strictly-parsed knob."""
+    return KNOBS[name].read()
+
+
+def get_raw(name: str) -> "str | None":
+    """The raw (unparsed) environment value of a registered knob, or
+    None when unset — for call sites that need tri-state unset/empty/
+    value semantics (e.g. the jax cache dir opt-out)."""
+    KNOBS[name]  # unregistered names must not silently read the env
+    return os.environ.get(name)
+
+
+def validate_all() -> "dict[str, Exception]":
+    """Parse every registered knob against the CURRENT environment;
+    returns {knob name: ConfigError} for each malformed one (empty ==
+    the environment is clean).  Service/bench entry points can call
+    this at startup to fail fast instead of mid-traffic."""
+    errors = {}
+    for name, knob in KNOBS.items():
+        try:
+            knob.read()
+        except ConfigError as e:
+            errors[name] = e
+    return errors
+
+
+def knob_table() -> "list[tuple[str, str, str, str]]":
+    """(name, type, default, doc) rows for every registered knob —
+    the data behind the README knob table."""
+    rows = []
+    for name, knob in KNOBS.items():
+        if knob.type == "choice":
+            ty = "choice of " + "/".join(knob.choices)
+        else:
+            ty = knob.type
+        default = "unset" if knob.default is None else str(knob.default)
+        rows.append((name, ty, default, knob.doc))
+    return rows

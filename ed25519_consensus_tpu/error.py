@@ -26,3 +26,19 @@ class InvalidSignature(Error):
 class InvalidSliceLength(Error):
     def __init__(self):
         super().__init__("Invalid length when parsing byte slice.")
+
+
+class ConfigError(Error):
+    """A malformed ED25519_TPU_* environment knob (config.py registry).
+
+    Raised at READ time with the knob name, the raw value, and what was
+    expected — instead of a bare ValueError escaping from deep inside
+    the routing or scheduler path."""
+
+    def __init__(self, name: str, raw: str, expected: str):
+        super().__init__(
+            f"Invalid value {raw!r} for {name}: expected {expected}."
+        )
+        self.name = name
+        self.raw = raw
+        self.expected = expected

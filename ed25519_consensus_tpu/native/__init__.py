@@ -17,6 +17,8 @@ import ctypes
 import os
 import subprocess
 
+from .. import config as _config
+
 _SRC = os.path.join(os.path.dirname(__file__), "fe25519.cpp")
 _SO = os.path.join(os.path.dirname(__file__), "_fe25519.so")
 
@@ -61,9 +63,8 @@ def _disabled_by_request() -> bool:
     call: a disable is its own state, NOT a latched failure — unsetting
     the env var mid-process re-enables the library, and `_lib_failed`
     keeps meaning exactly 'build/load/self-check failed'."""
-    return os.environ.get("ED25519_TPU_DISABLE_NATIVE", "").lower() in (
-        "1", "true", "yes"
-    )  # explicit opt-outs only: "0"/"false" must NOT disable
+    # config.py `opt-in` type: "0"/"false" must NOT disable (live read)
+    return _config.get("ED25519_TPU_DISABLE_NATIVE")
 
 
 def load():

@@ -52,6 +52,7 @@ import threading
 
 import numpy as np
 
+from .. import config as _config
 from . import limbs
 from .edwards import Point, shift128
 from .limbs import NLIMBS
@@ -80,7 +81,7 @@ def ensure_compile_cache():
         return
     import os
 
-    d = os.environ.get("ED25519_TPU_JAX_CACHE_DIR")
+    d = _config.get("ED25519_TPU_JAX_CACHE_DIR")
     if d is None:
         d = os.path.expanduser("~/.cache/ed25519_tpu_jax")
     if not d:
@@ -301,9 +302,7 @@ def _use_pallas() -> bool:
     """Kernel selection: the Mosaic kernel on real TPU backends, the XLA
     scan kernel elsewhere (CPU CI, virtual meshes).  Overridable via
     ED25519_TPU_MSM_KERNEL=pallas|xla."""
-    import os
-
-    mode = os.environ.get("ED25519_TPU_MSM_KERNEL", "auto")
+    mode = _config.get("ED25519_TPU_MSM_KERNEL")
     if mode == "pallas":
         return True
     if mode == "xla":

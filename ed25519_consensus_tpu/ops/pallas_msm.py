@@ -43,6 +43,7 @@ import functools
 
 import numpy as np
 
+from .. import config as _config
 from .limbs import FOLD, LIMB_BITS, NLIMBS, NWINDOWS
 from .field import D2, P
 from . import limbs as limbs_mod
@@ -281,9 +282,6 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
     )
 
 
-_BODY_STYLES = ("rolled", "hybrid")
-
-
 def _body_style() -> str:
     """Kernel body selection (ED25519_TPU_PALLAS_BODY overrides):
 
@@ -301,11 +299,9 @@ def _body_style() -> str:
     its B = 8 executable stopped compiling through the r3 helper
     entirely (kernel_body_ab_r3.txt), and a fallback that cannot
     compile at the shipped shape is risk, not redundancy.  An explicit
-    ED25519_TPU_PALLAS_BODY=unrolled falls back to `rolled`."""
-    import os
-
-    v = os.environ.get("ED25519_TPU_PALLAS_BODY", "rolled").lower()
-    return v if v in _BODY_STYLES else "rolled"
+    ED25519_TPU_PALLAS_BODY=unrolled falls back to `rolled` (the
+    config.py `choice` type keeps that documented fallback)."""
+    return _config.get("ED25519_TPU_PALLAS_BODY")
 
 
 @functools.lru_cache(maxsize=None)
@@ -387,20 +383,19 @@ def _auto_win_chunk(nwin: int) -> int:
     """Windows per grid step: measured on v5e (tools/kernel_lab.py,
     BASELINE.md), each grid step carries ~320 µs fixed cost next to
     ~470 µs per window of work, so batching 11 windows per step is ~1.6×
-    end-to-end.  Overridable via ED25519_TPU_WIN_CHUNK."""
-    import os
+    end-to-end.  Overridable via ED25519_TPU_WIN_CHUNK: a non-integer
+    raises config.ConfigError at read time (registry contract); an
+    integer that is not a positive divisor of the window count is
+    warned about and ignored here (divisibility depends on nwin, which
+    the registry cannot know)."""
     import warnings
 
-    env = os.environ.get("ED25519_TPU_WIN_CHUNK")
-    if env:
-        try:
-            w = int(env)
-        except ValueError:
-            w = 0
+    w = _config.get("ED25519_TPU_WIN_CHUNK")
+    if w is not None:
         if w > 0 and nwin % w == 0:
             return w
         warnings.warn(
-            f"ED25519_TPU_WIN_CHUNK={env!r} ignored: must be a positive "
+            f"ED25519_TPU_WIN_CHUNK={w!r} ignored: must be a positive "
             f"divisor of {nwin}", stacklevel=2)
     for w in (11, 3):
         if nwin % w == 0:

@@ -42,9 +42,9 @@ Env knobs (config surface, SURVEY.md §5):
   hardware.
 """
 
-import os
 import threading
 
+from . import config as _config
 from . import health as _health
 
 __all__ = [
@@ -73,8 +73,7 @@ def available_devices() -> int:
     unavailable or explicitly disabled.  Never imports jax when
     ED25519_TPU_DISABLE_DEVICE is set — the knob's contract is that the
     accelerator stack stays entirely unloaded."""
-    if os.environ.get("ED25519_TPU_DISABLE_DEVICE", "").lower() in (
-            "1", "true", "yes"):
+    if _config.get("ED25519_TPU_DISABLE_DEVICE"):
         return 0
     if _device_count[0] is None:
         try:
@@ -107,11 +106,14 @@ class RoutingPolicy:
                  per_term_s: float = None,
                  min_devices: int = 2,
                  auto_mesh: bool = None):
+        # Env overrides come through the config.py registry: a
+        # malformed ED25519_TPU_MESH_* value raises a typed ConfigError
+        # HERE, at policy construction — not a bare ValueError (or a
+        # silent fallback masking an operator typo) deep in the
+        # routing of a verify_many call.
         def _env_f(name, fallback):
-            try:
-                return float(os.environ.get(name, "") or fallback)
-            except ValueError:
-                return fallback
+            v = _config.get(name)
+            return fallback if v is None else v
 
         self.fixed_cost_s = (fixed_cost_s if fixed_cost_s is not None
                              else _env_f("ED25519_TPU_MESH_FIXED_COST",
@@ -121,9 +123,7 @@ class RoutingPolicy:
                                        DEFAULT_PER_TERM_S))
         self.min_devices = int(min_devices)
         if auto_mesh is None:
-            auto_mesh = os.environ.get(
-                "ED25519_TPU_AUTO_MESH", "").lower() not in (
-                "0", "false", "no")
+            auto_mesh = _config.get("ED25519_TPU_AUTO_MESH")
         self.auto_mesh = bool(auto_mesh)
 
     def crossover_terms(self, n_devices: int) -> float:

@@ -15,12 +15,53 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Lock-order audit (analysis layer 3): ED25519_TPU_LOCK_AUDIT=1 makes
+# every lock CREATED FROM REPO CODE an instrumented wrapper recording
+# the acquisition graph; the session-end fixture below fails the run on
+# a cyclic graph.  The module is loaded STANDALONE by file path — it
+# must be installed before `ed25519_consensus_tpu` is imported (the
+# package's module-level locks are created at import time), and
+# importing it as a package submodule would import the package first.
+_LOCK_AUDIT = None
+if os.environ.get("ED25519_TPU_LOCK_AUDIT"):
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_ed25519_tpu_lockorder",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     "ed25519_consensus_tpu", "analysis", "lockorder.py"))
+    _LOCK_AUDIT = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_LOCK_AUDIT)
+    _LOCK_AUDIT.install()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lock_order_audit_at_session_end():
+    """With ED25519_TPU_LOCK_AUDIT=1: check the recorded lock
+    acquisition graph for cycles at session end and fail the run on
+    one — a cyclic order observed across the threaded suites is a
+    latent deadlock, whatever the tests themselves asserted.  The
+    derived partial order is printed (and written to
+    $ED25519_TPU_LOCK_AUDIT_OUT if set) for
+    docs/consensus-invariants.md."""
+    yield
+    if _LOCK_AUDIT is None:
+        return
+    import sys
+
+    report = _LOCK_AUDIT.finish(
+        write_path=os.environ.get("ED25519_TPU_LOCK_AUDIT_OUT"))
+    print("\n" + _LOCK_AUDIT.render(report), file=sys.stderr)
+    assert not report["cycles"], (
+        "cyclic lock-acquisition order observed (latent deadlock): "
+        + "; ".join(" -> ".join(c) for c in report["cycles"]))
 
 
 @pytest.fixture(autouse=True, scope="session")

@@ -1,0 +1,724 @@
+"""Self-tests for the consensuslint AST layer (analysis/linter.py).
+
+A fixture corpus with one minimal POSITIVE (clean) and NEGATIVE
+(violating) case per rule CL001-CL006 — the acceptance gate that
+`tools/consensuslint.py` exits nonzero on each violation class —
+plus the waiver machinery's contracts (suppression, mandatory
+justification, stale-waiver failure) and the HEAD gate: the real
+package must lint clean under the committed waiver file."""
+
+import pytest
+
+from ed25519_consensus_tpu.analysis import linter
+
+
+def lint_fixture(relpath: str, source: str):
+    """Lint one in-memory fixture as if it lived at `relpath` inside
+    the package."""
+    mod = linter.ParsedModule(
+        path=f"<fixture:{relpath}>", source=source,
+        relpath=f"ed25519_consensus_tpu/{relpath}")
+    return linter.lint_module(mod)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- CL001: float-free consensus path --------------------------------------
+
+def test_cl001_negative_float_literal_in_ops():
+    findings = lint_fixture("ops/fixture.py", "SCALE = 0.5\n")
+    assert rules_of(findings) == ["CL001"]
+
+
+def test_cl001_negative_float_dtype_in_kernel():
+    src = ("def kernel(x):\n"
+           "    import jax.numpy as jnp\n"
+           "    return x.astype(jnp.float64)\n")
+    findings = lint_fixture("ops/fixture.py", src)
+    assert rules_of(findings) == ["CL001"]
+
+
+def test_cl001_negative_verdict_symbol_in_batch():
+    src = ("class Verifier:\n"
+           "    def _stage_queue_order(self, rng):\n"
+           "        return 1.5\n")
+    findings = lint_fixture("batch.py", src)
+    assert rules_of(findings) == ["CL001"]
+
+
+def test_cl001_positive_scheduler_floats_allowed_in_batch():
+    # Scheduler timeouts/EMAs in batch.py are OUTSIDE the verdict-path
+    # symbol scope — floats there are fine (the injected-clock rule
+    # CL002 covers their time discipline instead).
+    src = ("def poll(block):\n"
+           "    budget = 0.25\n"
+           "    return budget\n")
+    assert lint_fixture("batch.py", src) == []
+
+
+def test_cl001_positive_integer_kernel():
+    src = ("import numpy as np\n"
+           "def kernel(x):\n"
+           "    return (x.astype(np.int32) * 3) >> 2\n")
+    assert lint_fixture("ops/fixture.py", src) == []
+
+
+# -- CL002: injected clocks only -------------------------------------------
+
+def test_cl002_negative_raw_monotonic():
+    src = ("import time as _time\n"
+           "def poll():\n"
+           "    return _time.monotonic()\n")
+    findings = lint_fixture("batch.py", src)
+    assert rules_of(findings) == ["CL002"]
+
+
+def test_cl002_negative_from_import():
+    src = ("from time import monotonic\n"
+           "def poll():\n"
+           "    return monotonic()\n")
+    assert rules_of(lint_fixture("service.py", src)) == ["CL002"]
+
+
+def test_cl002_positive_clock_and_perf_counter():
+    src = ("import time\n"
+           "def bench(clock):\n"
+           "    t0 = time.perf_counter()\n"  # metrics timing: allowed
+           "    return clock.monotonic() - t0\n")
+    assert lint_fixture("batch.py", src) == []
+
+
+def test_cl002_positive_health_module_is_the_sanctioned_home():
+    src = ("import time\n"
+           "class Clock:\n"
+           "    def monotonic(self):\n"
+           "        return time.monotonic()\n")
+    assert lint_fixture("health.py", src) == []
+
+
+# -- CL003: central knob registry ------------------------------------------
+
+def test_cl003_negative_raw_environ():
+    src = ("import os\n"
+           "def knob():\n"
+           "    return os.environ.get('ED25519_TPU_X', '')\n")
+    assert rules_of(lint_fixture("routing.py", src)) == ["CL003"]
+
+
+def test_cl003_negative_from_import_environ():
+    src = ("from os import environ\n"
+           "def knob():\n"
+           "    return environ['ED25519_TPU_X']\n")
+    assert rules_of(lint_fixture("routing.py", src)) == ["CL003"]
+
+
+def test_cl003_positive_config_module_exempt():
+    src = ("import os\n"
+           "def read(name):\n"
+           "    return os.environ.get(name)\n")
+    assert lint_fixture("config.py", src) == []
+
+
+# -- CL004: module-global mutable state freeze -----------------------------
+
+def test_cl004_negative_new_cache_global():
+    findings = lint_fixture("service.py", "_wave_cache = {}\n")
+    assert rules_of(findings) == ["CL004"]
+    assert "_wave_cache" in findings[0].message
+
+
+def test_cl004_positive_locks_and_allowlisted():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_cv = threading.Condition()\n"
+           "_BREAKER_GAUGE = {'closed': 0}\n")  # allowlisted name
+    assert lint_fixture("service.py", src) == []
+
+
+def test_cl004_positive_out_of_scope_module():
+    # The freeze guards the scheduler/service modules; ops caches are
+    # CL001/CL002 territory, not CL004.
+    assert lint_fixture("ops/fixture.py", "_cache = {}\n") == []
+
+
+# -- CL005: secret hygiene -------------------------------------------------
+
+def test_cl005_negative_repr_leaks_scalar():
+    src = ("class SigningKey:\n"
+           "    def __repr__(self):\n"
+           "        return f'SigningKey(s={self.s:#x})'\n")
+    assert rules_of(lint_fixture("signing_key.py", src)) == ["CL005"]
+
+
+def test_cl005_negative_print_leaks_prefix():
+    src = ("class SigningKey:\n"
+           "    def debug(self):\n"
+           "        print('prefix', self.prefix)\n")
+    assert rules_of(lint_fixture("signing_key.py", src)) == ["CL005"]
+
+
+def test_cl005_negative_repr_serializes_secret():
+    src = ("class SigningKey:\n"
+           "    def __repr__(self):\n"
+           "        return repr(self.to_bytes())\n")
+    assert rules_of(lint_fixture("signing_key.py", src)) == ["CL005"]
+
+
+def test_cl005_positive_redacting_repr():
+    src = ("class SigningKey:\n"
+           "    def __repr__(self):\n"
+           "        return f'SigningKey(vk={self.vk!r}, s=<redacted>)'\n")
+    assert lint_fixture("signing_key.py", src) == []
+
+
+# -- CL006: verdict-path discipline ----------------------------------------
+
+def test_cl006_negative_bare_except():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except:\n"
+           "        pass\n")
+    assert rules_of(lint_fixture("batch.py", src)) == ["CL006"]
+
+
+def test_cl006_negative_overbroad_except():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    assert rules_of(lint_fixture("service.py", src)) == ["CL006"]
+
+
+def test_cl006_negative_poison_entry_map_surgery_regression():
+    """The pre-round-6 verify_single_many aggregated per-entry verdicts
+    by iterating the coalescing MAP (after poison-entry surgery on it)
+    — exactly the dict-iteration-ordered verdict aggregation CL006
+    exists to flag.  Minimal reproduction of that shape."""
+    src = ("def verify_single_many(entries):\n"
+           "    staging = _stage_all(entries)\n"
+           "    verdicts = [False] * len(entries)\n"
+           "    i = 0\n"
+           "    for vkb, ksigs in staging.signatures.items():\n"
+           "        for k, sig in ksigs:\n"
+           "            verdicts[i] = _check(vkb, k, sig)\n"
+           "            i += 1\n"
+           "    return verdicts\n")
+    findings = lint_fixture("batch.py", src)
+    assert rules_of(findings) == ["CL006"]
+    assert "iteration order" in findings[0].message
+
+
+def test_cl006_negative_set_iteration_verdicts():
+    src = ("def decide(bad):\n"
+           "    verdicts = []\n"
+           "    for i in set(bad):\n"
+           "        verdicts.append(i)\n"
+           "    return verdicts\n")
+    assert rules_of(lint_fixture("service.py", src)) == ["CL006"]
+
+
+def test_cl006_positive_submission_order_aggregation():
+    src = ("def decide(reqs, verdicts):\n"
+           "    out = []\n"
+           "    for req, verdict in zip(reqs, verdicts):\n"
+           "        out.append((req, verdict))\n"
+           "    for vkb, sigs in groups.items():\n"
+           "        table[vkb] = len(sigs)\n"  # not a verdict target
+           "    return out\n")
+    assert lint_fixture("service.py", src) == []
+
+
+def test_cl006_positive_narrow_except():
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except (StopIteration, RuntimeError):\n"
+           "        pass\n")
+    assert lint_fixture("batch.py", src) == []
+
+
+# -- waivers ---------------------------------------------------------------
+
+def _one_finding():
+    return lint_fixture("service.py", "_wave_cache = {}\n")
+
+
+def test_waiver_suppresses_matching_finding():
+    findings = _one_finding()
+    waivers = [{"rule": "CL004",
+                "path": "ed25519_consensus_tpu/service.py",
+                "symbol": "<module>",
+                "reason": "test"}]
+    active, waived = linter.apply_waivers(findings, waivers)
+    assert active == [] and len(waived) == 1
+
+
+def test_stale_waiver_fails():
+    waivers = [{"rule": "CL001",
+                "path": "ed25519_consensus_tpu/service.py",
+                "symbol": "nope",
+                "reason": "stale"}]
+    with pytest.raises(linter.WaiverError, match="stale"):
+        linter.apply_waivers(_one_finding(), waivers)
+
+
+def test_waiver_requires_justification(tmp_path):
+    p = tmp_path / "waivers.toml"
+    p.write_text('[[waiver]]\nrule = "CL004"\n'
+                 'path = "x"\nsymbol = "<module>"\n')
+    with pytest.raises(linter.WaiverError, match="reason"):
+        linter.load_waivers(str(p))
+
+
+def test_waiver_toml_parses_committed_file():
+    waivers = linter.load_waivers()
+    assert waivers, "the committed waiver file must load"
+    assert all(w["reason"] for w in waivers)
+
+
+# -- the HEAD gate ---------------------------------------------------------
+
+def test_package_lints_clean_under_committed_waivers():
+    """`python tools/consensuslint.py ed25519_consensus_tpu/` must exit
+    0 on HEAD: every finding on the current tree is explicitly waived
+    with a justification, and no waiver is stale."""
+    findings = linter.lint_package()
+    active, waived = linter.apply_waivers(findings, linter.load_waivers())
+    assert active == [], "unwaived findings on HEAD:\n" + "\n".join(
+        str(f) for f in active)
+
+
+def test_stats_shape():
+    st = linter.stats()
+    assert st["findings_active"] == 0
+    assert st["waiver_count"] >= 1
+    assert set(st["rule_counts"]) == set(linter.RULE_IDS)
+
+
+# -- Layer 2: the jaxpr IR audit -------------------------------------------
+#
+# The audit's contract (analysis/ir_audit.py): a traced verdict kernel
+# is integer-only, denylist-clean, and pinned to the committed
+# primitive manifest.  These tests inject violations into SCRATCH
+# branches of the real kernels and assert the audit catches them.
+
+def _audited_xla_kernel():
+    from ed25519_consensus_tpu.analysis import ir_audit
+    from ed25519_consensus_tpu.ops import msm
+    from ed25519_consensus_tpu.ops.limbs import NWINDOWS
+
+    kernel = msm._compiled_kernel_many.__wrapped__(
+        ir_audit._B, ir_audit._N, NWINDOWS,
+        wire="compressed", dwire="packed")
+    return kernel, ir_audit._operands()
+
+
+def test_ir_audit_clean_on_real_kernel():
+    """The real XLA scan kernel must pass the manifest-free invariant
+    checks (integer-only, denylist-clean) — the baseline the injection
+    tests below poison."""
+    from ed25519_consensus_tpu.analysis import ir_audit
+
+    kernel, (digits, pts) = _audited_xla_kernel()
+    summary, problems = ir_audit.audit_fn("xla-baseline", kernel,
+                                          digits, pts)
+    assert problems == []
+    assert all(not dt.startswith(("float", "bfloat", "complex"))
+               for dt in summary["dtypes"])
+
+
+def test_ir_audit_rejects_float64_injection():
+    """ACCEPTANCE GATE: a deliberate float64 round-trip grafted onto a
+    scratch branch of the real kernel must fail the audit — this is the
+    drift the AST linter (CL001, syntax-level) cannot see, because the
+    float never appears as a literal or dtype STRING in source."""
+    import jax
+    from jax.experimental import enable_x64
+    import jax.numpy as jnp
+
+    from ed25519_consensus_tpu.analysis import ir_audit
+
+    kernel, (digits, pts) = _audited_xla_kernel()
+    # Trace the kernel under the production (32-bit) config, then
+    # replay that jaxpr inside the x64 context: the kernel's own dtypes
+    # stay pinned by the trace while the grafted scratch branch really
+    # is float64 (tracing the kernel SOURCE under x64 would instead
+    # shift its numpy int constants to int64 — a different program).
+    closed = jax.make_jaxpr(kernel)(digits, pts)
+    eval_jaxpr = getattr(jax.core, "eval_jaxpr", None)
+    if eval_jaxpr is None:  # removed from jax.core in jax >= 0.6
+        from jax._src.core import eval_jaxpr
+
+    def poisoned(digits, pts):
+        outs = eval_jaxpr(closed.jaxpr, closed.consts, digits, pts)
+        # the scratch branch: an innocuous-looking float64 round-trip
+        # (e.g. a "scaling" someone thought was exact)
+        outs[0] = (outs[0].astype(jnp.float64) * 1).astype(
+            outs[0].dtype)
+        return outs
+
+    with enable_x64():
+        summary, problems = ir_audit.audit_fn("scratch-float64",
+                                              poisoned, digits, pts)
+    assert any("float64" in dt for dt in summary["dtypes"])
+    assert any("float64" in p for p in problems), problems
+
+
+def test_ir_audit_rejects_denylisted_rng_primitive():
+    """Random bits in a verification kernel (a verdict must be a pure
+    function of its inputs) trip the primitive denylist."""
+    import jax
+
+    from ed25519_consensus_tpu.analysis import ir_audit
+
+    def scratch(x):
+        key = jax.random.PRNGKey(0)
+        return x + jax.random.randint(key, x.shape, 0, 7, dtype=x.dtype)
+
+    import numpy as np
+
+    _, problems = ir_audit.audit_fn(
+        "scratch-rng", scratch, np.zeros((4,), dtype=np.int32))
+    assert any("denylisted" in p for p in problems), problems
+
+
+def test_ir_audit_detects_manifest_drift_and_collective_reorder():
+    """Any divergence from the committed manifest is reported with a
+    diff: a new primitive, a vanished dtype, and — reported distinctly
+    — a REORDERED collective schedule with unchanged membership (how
+    cross-chip nondeterminism ships)."""
+    from ed25519_consensus_tpu.analysis import ir_audit
+
+    committed = {"variants": {
+        "v": {"primitives": ["add", "mul"], "dtypes": ["int32"],
+              "collectives": ["all_gather", "psum"]},
+    }}
+    current = {"variants": {
+        "v": {"primitives": ["add", "mul", "div"], "dtypes": ["int32"],
+              "collectives": ["psum", "all_gather"]},
+        "brand-new": {"primitives": [], "dtypes": [],
+                      "collectives": []},
+    }}
+    drift = ir_audit.diff_manifests(committed, current)
+    assert any("+['div']" in d for d in drift)
+    assert any("ORDER changed" in d for d in drift)
+    assert any("brand-new" in d and "not in committed" in d
+               for d in drift)
+    # …and a variant the current backend cannot trace is NOT drift
+    assert ir_audit.diff_manifests(
+        {"variants": {"sharded-mesh2": {"primitives": [], "dtypes": [],
+                                        "collectives": []}}},
+        {"variants": {}}) == []
+
+
+@pytest.mark.slow
+def test_committed_manifest_matches_fresh_trace():
+    """The committed jaxpr_manifest.json matches a fresh interpret-mode
+    trace of every variant the backend can build here — the same gate
+    CI's `consensuslint --ir-audit` step enforces (slow: ~35 s of
+    Pallas interpret-mode tracing)."""
+    from ed25519_consensus_tpu.analysis import ir_audit
+
+    manifest, problems = ir_audit.build_manifest()
+    assert problems == []
+    committed = ir_audit.load_manifest()
+    assert committed is not None, "jaxpr_manifest.json must be committed"
+    assert ir_audit.diff_manifests(committed, manifest) == []
+
+
+# -- the waiver-count ratchet ----------------------------------------------
+
+def test_waiver_count_is_pinned():
+    """The committed waiver count is a RATCHET: growing it must be a
+    deliberate, reviewed act (update this pin in the same commit as the
+    new waivers.toml entry and say why in the entry's reason).  Soak
+    tooling asserts the same number off the consensuslint_waivers gauge
+    (tools/load_soak.py)."""
+    assert len(linter.load_waivers()) == 5
+
+
+def test_publish_gauges_mirrors_stats():
+    from ed25519_consensus_tpu.utils import metrics
+
+    st = linter.publish_gauges()
+    g = metrics.gauges()
+    assert g["consensuslint_waivers"] == st["waiver_count"] == 5
+    assert g["consensuslint_findings_active"] == 0
+    assert g["jaxpr_manifest_hash"] == st["manifest_hash"]
+
+
+# -- the CL003 knob registry (config.py) -----------------------------------
+
+def test_config_malformed_float_raises_configerror(monkeypatch):
+    """The satellite fix: a malformed numeric knob raises a typed
+    ConfigError naming the knob and the raw value AT READ TIME — not a
+    bare ValueError from deep inside the scheduler."""
+    from ed25519_consensus_tpu import config
+    from ed25519_consensus_tpu.error import ConfigError, Error
+
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "fast")
+    with pytest.raises(ConfigError, match="ED25519_TPU_EMA_PRIOR"):
+        config.get("ED25519_TPU_EMA_PRIOR")
+    try:
+        config.get("ED25519_TPU_EMA_PRIOR")
+    except ConfigError as e:
+        assert e.raw == "fast" and isinstance(e, Error)
+
+
+def test_config_malformed_mesh_cost_fails_routing_loudly(monkeypatch):
+    """The old routing.py read was `float(os.environ.get(...) or
+    default)` with a bare-ValueError failure mode; the registry makes a
+    malformed ED25519_TPU_MESH_FIXED_COST a clear ConfigError at
+    RoutingPolicy construction."""
+    from ed25519_consensus_tpu import routing
+    from ed25519_consensus_tpu.error import ConfigError
+
+    monkeypatch.setenv("ED25519_TPU_MESH_FIXED_COST", "3O0us")  # typo'd
+    with pytest.raises(ConfigError,
+                       match="ED25519_TPU_MESH_FIXED_COST"):
+        routing.RoutingPolicy()
+    # …and an explicit constructor arg never touches the environment
+    monkeypatch.setenv("ED25519_TPU_MESH_FIXED_COST", "")
+    assert routing.RoutingPolicy(fixed_cost_s=0.3).fixed_cost_s == 0.3
+
+
+def test_config_knob_type_semantics(monkeypatch):
+    """The historical per-site parsing conventions each knob kept:
+    choice falls back on junk (documented `unrolled` legacy), opt-in
+    ignores 'false', opt-out honors only 0/false/no, reads are live."""
+    from ed25519_consensus_tpu import config
+
+    monkeypatch.setenv("ED25519_TPU_PALLAS_BODY", "unrolled")
+    assert config.get("ED25519_TPU_PALLAS_BODY") == "rolled"
+    monkeypatch.setenv("ED25519_TPU_DISABLE_NATIVE", "false")
+    assert config.get("ED25519_TPU_DISABLE_NATIVE") is False
+    monkeypatch.setenv("ED25519_TPU_DISABLE_NATIVE", "1")
+    assert config.get("ED25519_TPU_DISABLE_NATIVE") is True
+    monkeypatch.setenv("ED25519_TPU_AUTO_MESH", "no")
+    assert config.get("ED25519_TPU_AUTO_MESH") is False
+    monkeypatch.delenv("ED25519_TPU_AUTO_MESH")
+    assert config.get("ED25519_TPU_AUTO_MESH") is True
+    with pytest.raises(KeyError):
+        config.get("ED25519_TPU_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        config.get_raw("ED25519_TPU_NOT_A_KNOB")
+
+
+def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
+    from ed25519_consensus_tpu import config
+
+    assert config.validate_all() == {}
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "x")
+    monkeypatch.setenv("ED25519_TPU_WIN_CHUNK", "many")
+    errs = config.validate_all()
+    assert set(errs) == {"ED25519_TPU_EMA_PRIOR",
+                         "ED25519_TPU_WIN_CHUNK"}
+
+
+def test_config_registry_covers_readme_table():
+    """Every registered knob has a doc line (the README table renders
+    these rows) and the registry knows all 13 knobs."""
+    from ed25519_consensus_tpu import config
+
+    rows = config.knob_table()
+    assert len(rows) == len(config.KNOBS) == 13
+    assert all(doc for (_, _, _, doc) in rows)
+
+
+# -- the CLI exit-code contract --------------------------------------------
+
+def _cli_main():
+    import importlib.util
+    import os
+
+    path = os.path.join(linter.REPO_ROOT, "tools", "consensuslint.py")
+    spec = importlib.util.spec_from_file_location("_consensuslint_cli",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_cli_exit_codes():
+    """`python tools/consensuslint.py ed25519_consensus_tpu/` exits 0
+    on HEAD (every finding waived); with --no-waivers the same tree's
+    findings surface and the exit is nonzero — the code path every
+    negative fixture above rides through CI."""
+    main = _cli_main()
+    assert main([linter.PACKAGE_ROOT]) == 0
+    assert main(["--no-waivers", linter.PACKAGE_ROOT]) == 1
+
+
+# -- Layer 3: lock-order verification --------------------------------------
+#
+# The monitor and wrapper mechanics, with the negative cases the
+# env-gated CI run cannot show green-side: a seeded AB/BA inversion
+# must surface as exactly one cycle, and same-site instance nesting
+# must fail rather than hide behind the site-keyed graph.
+
+def _lockorder():
+    from ed25519_consensus_tpu.analysis import lockorder
+
+    return lockorder
+
+
+def test_lockorder_ab_ba_inversion_is_one_cycle():
+    lo = _lockorder()
+    m = lo.LockOrderMonitor()
+    # A held while acquiring B …
+    m.note_acquired(1, "A")
+    m.note_wait(2, "B")
+    m.note_acquired(2, "B")
+    m.note_released(2)
+    m.note_released(1)
+    # … then B held while acquiring A: the classic inversion
+    m.note_acquired(2, "B")
+    m.note_wait(1, "A")
+    m.note_acquired(1, "A")
+    rep = m.report()
+    assert set(map(tuple, (e[:2] for e in rep["edges"]))) == {
+        ("A", "B"), ("B", "A")}
+    # found from both entry nodes, deduped to the ONE A<->B cycle
+    assert len(rep["cycles"]) == 1
+
+
+def test_lockorder_acyclic_graph_layers_topologically():
+    lo = _lockorder()
+    m = lo.LockOrderMonitor()
+    for (a, b), (ai, bi) in ((("A", "B"), (1, 2)), (("B", "C"), (2, 3)),
+                             (("A", "C"), (1, 3))):
+        m.note_acquired(ai, a)
+        m.note_wait(bi, b)
+        m.note_acquired(bi, b)
+        m.note_released(bi)
+        m.note_released(ai)
+    rep = m.report()
+    assert rep["cycles"] == []
+    assert rep["partial_order"] == [["A"], ["B"], ["C"]]
+
+
+def test_lockorder_same_site_instances_flagged_reentry_not():
+    lo = _lockorder()
+    m = lo.LockOrderMonitor()
+    # true re-entry (same object): silent — an RLock cannot deadlock
+    # against itself
+    m.note_acquired(1, "S")
+    m.note_wait(1, "S")
+    assert m.edges() == {}
+    # a DIFFERENT instance from the same creation site: recorded and
+    # cyclic — site-keyed edges cannot prove the instance order is
+    # consistent, so same-site nesting must fail the audit
+    m.note_wait(2, "S")
+    assert m.edges() == {("S", "S"): 1}
+    assert m.find_cycles() == [["S", "S"]]
+
+
+def test_lockorder_instrumented_locks_record_threads(monkeypatch):
+    """End-to-end through the real wrappers: two threads taking two
+    instrumented locks in opposite orders (sequentially — no actual
+    deadlock) must produce a detected cycle in the aggregated graph."""
+    import threading
+
+    lo = _lockorder()
+    monkeypatch.setattr(lo, "MONITOR", lo.LockOrderMonitor())
+    la = lo._InstrumentedLock(lo._REAL_LOCK(), "t:LA")
+    lb = lo._InstrumentedLock(lo._REAL_LOCK(), "t:LB")
+    with la:
+        with lb:
+            pass
+
+    def inverted():
+        with lb:
+            with la:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    rep = lo.finish()
+    assert rep["cycles"], "the AB/BA inversion must be detected"
+
+
+def test_lockorder_install_wraps_repo_locks_only(monkeypatch):
+    import threading
+
+    lo = _lockorder()
+    monkeypatch.setattr(lo, "MONITOR", lo.LockOrderMonitor())
+    lo.install()
+    try:
+        assert lo.installed()
+        lk = threading.Lock()   # created from repo test code
+        rk = threading.RLock()
+        assert isinstance(lk, lo._InstrumentedLock)
+        assert isinstance(rk, lo._InstrumentedRLock)
+        assert "test_consensuslint" in lk.name
+        with lk:
+            with rk:
+                pass
+        assert lo.MONITOR.edges(), "nesting must record an edge"
+    finally:
+        lo.uninstall()
+    assert not lo.installed()
+
+
+def test_lockorder_rlock_reentry_records_no_false_edge(monkeypatch):
+    """Re-entering an OWNED RLock cannot block; it must not paint an
+    edge from other held locks to the RLock (which, with the genuine
+    outer-nesting edge, would report a false deadlock cycle on a
+    single deadlock-free thread)."""
+    lo = _lockorder()
+    monkeypatch.setattr(lo, "MONITOR", lo.LockOrderMonitor())
+    r = lo._InstrumentedRLock(lo._REAL_RLOCK(), "t:R")
+    lk = lo._InstrumentedLock(lo._REAL_LOCK(), "t:L")
+    with r:
+        with lk:
+            with r:   # re-entry while holding lk
+                pass
+    edges = lo.MONITOR.edges()
+    assert ("t:R", "t:L") in edges      # the genuine outer nesting
+    assert ("t:L", "t:R") not in edges  # no false re-entry edge
+    assert lo.MONITOR.find_cycles() == []
+
+
+def test_readme_knob_table_in_sync():
+    """README's knob table renders config.knob_table() verbatim — this
+    is the 'cannot drift from the code' contract: add or re-document a
+    knob and this test points at the README row to update."""
+    import os
+
+    from ed25519_consensus_tpu import config
+
+    with open(os.path.join(linter.REPO_ROOT, "README.md"),
+              encoding="utf-8") as f:
+        readme = f.read()
+    for name, ty, default, doc in config.knob_table():
+        row = f"| `{name}` | {ty} | {default} | {doc} |"
+        assert row in readme, (
+            f"README knob table out of sync with config.KNOBS — "
+            f"missing/stale row:\n{row}")
+
+
+def test_lockorder_condition_wait_under_reentrant_rlock(monkeypatch):
+    """Condition.wait under a reentrantly-held RLock releases every
+    recursion level and must RESTORE every level in the monitor's
+    held-stack: after the inner `with` exits, the thread still holds
+    the RLock, and a blocking acquire there must record its edge."""
+    import threading
+
+    lo = _lockorder()
+    monkeypatch.setattr(lo, "MONITOR", lo.LockOrderMonitor())
+    r = lo._InstrumentedRLock(lo._REAL_RLOCK(), "t:R")
+    cv = threading.Condition(r)
+    lk = lo._InstrumentedLock(lo._REAL_LOCK(), "t:L")
+    with r:
+        with r:
+            cv.wait(timeout=0.01)   # releases depth 2, restores depth 2
+        # depth 1 still held: this edge must not be lost
+        with lk:
+            pass
+    assert ("t:R", "t:L") in lo.MONITOR.edges()
+    assert lo.MONITOR.find_cycles() == []

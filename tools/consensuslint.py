@@ -1,0 +1,93 @@
+"""consensuslint CLI — the consensus-safety static analysis front door.
+
+    python tools/consensuslint.py ed25519_consensus_tpu/
+        Layer 1: run the CL001-CL006 AST rule catalog over the package,
+        apply analysis/waivers.toml, exit nonzero on any unwaived
+        finding (or any stale waiver).
+
+    python tools/consensuslint.py --ir-audit
+        Layer 2: trace the device MSM + every selectable Pallas kernel
+        variant in interpret mode and hold the jaxprs to the committed
+        primitive manifest (analysis/jaxpr_manifest.json).  Pass
+        --write-manifest to (re)generate the manifest after a REVIEWED
+        kernel change.
+
+    python tools/consensuslint.py --stats
+        Print rule counts, waiver count, and the manifest hash as JSON
+        and publish them into utils.metrics gauges (the soak tooling
+        asserts the waiver count never silently grows).
+
+Layer 3 (lock-order verification) runs inside pytest:
+    ED25519_TPU_LOCK_AUDIT=1 python -m pytest tests/test_service.py \
+        tests/test_scheduler.py tests/test_faults.py -q
+(tests/conftest.py installs the instrumentation and fails the session
+on a cyclic lock-acquisition graph; see docs/consensus-invariants.md).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu.analysis import linter  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="consensuslint",
+        description="consensus-safety static analysis (CL001-CL006 + "
+                    "jaxpr audit)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--waivers", default=linter.WAIVERS_PATH,
+                    help="waiver file (default: analysis/waivers.toml)")
+    ap.add_argument("--no-waivers", action="store_true",
+                    help="report every finding, waived or not")
+    ap.add_argument("--stats", action="store_true",
+                    help="print stats JSON and publish metrics gauges")
+    ap.add_argument("--ir-audit", action="store_true",
+                    help="run the Layer-2 jaxpr audit against the "
+                         "committed manifest")
+    ap.add_argument("--write-manifest", action="store_true",
+                    help="with --ir-audit: regenerate the committed "
+                         "manifest from the current kernels")
+    args = ap.parse_args(argv)
+
+    if args.ir_audit:
+        from ed25519_consensus_tpu.analysis import ir_audit
+
+        return ir_audit.main(write=args.write_manifest)
+
+    findings = (linter.lint_paths(args.paths) if args.paths
+                else linter.lint_package())
+    try:
+        waivers = [] if args.no_waivers else linter.load_waivers(
+            args.waivers)
+        active, waived = linter.apply_waivers(findings, waivers)
+    except linter.WaiverError as e:
+        print(f"consensuslint: waiver error: {e}", file=sys.stderr)
+        return 2
+
+    if args.stats:
+        st = linter.publish_gauges(
+            linter.stats(findings=findings, waivers=waivers))
+        print(linter.render_stats(st))
+        return 0 if not st["findings_active"] else 1
+
+    for f in waived:
+        print(f"waived: {f}")
+    for f in active:
+        print(f)
+    if active:
+        print(f"consensuslint: {len(active)} finding(s) "
+              f"({len(waived)} waived)", file=sys.stderr)
+        return 1
+    print(f"consensuslint: clean ({len(waived)} waived, "
+          f"{len(findings) - len(waived)} active)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

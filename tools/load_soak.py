@@ -212,7 +212,34 @@ def main(argv=None):
                     choices=["none", "stall", "death", "error",
                              "deadline", "mixed"])
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--max-waivers", type=int, default=5,
+                    help="consensuslint waiver ratchet: fail the soak if "
+                         "the committed waiver count exceeds this "
+                         "(matches test_waiver_count_is_pinned)")
     args = ap.parse_args(argv)
+
+    # Consensus-safety ratchet: publish the consensuslint gauges
+    # (consensuslint_waivers, consensuslint_findings_active, per-rule
+    # counts, jaxpr_manifest_hash — they ride in the summary's `gauges`
+    # below) and refuse to soak a tree whose static analysis is dirty
+    # or whose waiver count silently grew.
+    from ed25519_consensus_tpu.analysis import linter
+    try:
+        lint_st = linter.publish_gauges()
+    except linter.WaiverError as e:
+        print(f"VIOLATION: consensuslint waiver error — {e}; run "
+              f"`python tools/consensuslint.py ed25519_consensus_tpu/`",
+              file=sys.stderr)
+        sys.exit(2)
+    if lint_st["findings_active"] or \
+            lint_st["waiver_count"] > args.max_waivers:
+        print(f"VIOLATION: consensuslint gate — "
+              f"{lint_st['findings_active']} active finding(s), "
+              f"{lint_st['waiver_count']} waiver(s) "
+              f"(max {args.max_waivers}); run "
+              f"`python tools/consensuslint.py ed25519_consensus_tpu/`",
+              file=sys.stderr)
+        sys.exit(2)
 
     rnd = random.Random(args.seed)
     keys = [SigningKey.new(rnd) for _ in range(16)]
