@@ -36,6 +36,16 @@ the XLA scan kernel and the sharded mesh kernel):
 * ``pallas-win-chunk3`` — a non-default ED25519_TPU_WIN_CHUNK.
 * ``sharded-mesh2``     — the shard_map'd mesh kernel (requires ≥ 2
   devices; CI runs it on the 8-virtual-device CPU backend).
+* ``xla-devcache-assemble`` — the device operand cache's hot-path
+  entry (devcache.py): on-device assembly of the full point batch from
+  the RESIDENT keyset head tensor + the per-signature R wire, composed
+  with the same scan kernel the cold path runs.  Audited so the
+  residency optimization provably stays inside the integer-only
+  envelope — the wire shrink must not smuggle in new primitives.
+* ``sharded-mesh2-cached`` — the mesh lane's cache-aware dispatch
+  (per-shard residency).  Its collective schedule is held to exactly
+  ``['all_gather']``, same as the cold mesh path: residency must not
+  change what crosses the ICI.
 """
 
 import json
@@ -190,7 +200,7 @@ def trace_variants(include_sharded: "bool | None" = None) -> dict:
     import jax
 
     from ..ops import msm, pallas_msm
-    from ..ops.limbs import NWINDOWS
+    from ..ops.limbs import NLIMBS, NWINDOWS, PACKED_WINDOWS
 
     digits, pts = _operands()
     variants = {
@@ -199,6 +209,29 @@ def trace_variants(include_sharded: "bool | None" = None) -> dict:
                 _B, _N, NWINDOWS, wire="compressed", dwire="packed"),
             (digits, pts)),
     }
+    # The devcache hot path (production wire: packed digits + resident
+    # extended head + compressed R's), composed exactly as
+    # ops.msm.dispatch_window_sums_many_cached runs it: on-device
+    # assembly from the resident head, then the same scan kernel as the
+    # cold path over the assembled extended points.
+    _n_head, _n_r = 16, _N - 16
+    _n_r_mesh = 112  # per-shard: 16 head + 112 R = 128 = GROUP_LANES
+    _head = np.zeros((4, NLIMBS, _n_head), dtype=np.int16)
+    _head[1, 0, :] = 1  # Y = Z = 1: extended identity
+    _head[2, 0, :] = 1
+    _rwire = np.zeros((_B, 33, _n_r), dtype=np.uint8)
+    _rwire[:, 0, :] = 1
+    _cdigits = np.zeros((_B, PACKED_WINDOWS, _N), dtype=np.uint8)
+    _assemble = msm._compiled_assemble_cached.__wrapped__(
+        _B, _n_head, _n_r)
+    _ckernel = msm._compiled_kernel_many.__wrapped__(
+        _B, _N, NWINDOWS, wire="extended", dwire="packed")
+
+    def _cached_dispatch(digits, head, rwire):
+        return _ckernel(digits, _assemble(head, rwire))
+
+    variants["xla-devcache-assemble"] = (
+        _cached_dispatch, (_cdigits, _head, _rwire))
     for name, kwargs in (
             ("pallas-rolled", dict(body="rolled", win_chunk=11)),
             ("pallas-hybrid", dict(body="hybrid", win_chunk=3)),
@@ -222,6 +255,18 @@ def trace_variants(include_sharded: "bool | None" = None) -> dict:
                 2, _B, _N // 2, NWINDOWS, wire="compressed",
                 dwire="packed"),
             (digits, pts))
+        # The cache-aware mesh dispatch: per-shard lanes are
+        # n_head + NR/D = 16 + 112 = 128 (a valid kernel lane count),
+        # head digits on shard 0's slice only, head tensor replicated.
+        _nr2 = 2 * _n_r_mesh
+        variants["sharded-mesh2-cached"] = (
+            sharded_msm._compiled_sharded_kernel_many_cached(
+                2, _B, _n_head, _n_r_mesh, NWINDOWS, dwire="packed"),
+            (np.zeros((_B, PACKED_WINDOWS, 2 * _n_head),
+                      dtype=np.uint8),
+             np.zeros((_B, PACKED_WINDOWS, _nr2), dtype=np.uint8),
+             _head,
+             np.concatenate([_rwire[:, :, :_n_r_mesh]] * 2, axis=-1)))
     return variants
 
 
@@ -237,15 +282,19 @@ def build_manifest(include_sharded: "bool | None" = None
         summary, probs = audit_fn(name, fn, *args)
         manifest["variants"][name] = summary
         problems.extend(probs)
-    # The sharded path must actually use a stable collective schedule:
+    # The sharded paths must actually use a stable collective schedule:
     # exactly one all_gather (the ICI all-reduce of partial window
-    # sums), nothing else, in that order.
-    sh = manifest["variants"].get("sharded-mesh2")
-    if sh is not None and sh["collectives"] != ["all_gather"]:
-        problems.append(
-            f"sharded-mesh2: collective schedule {sh['collectives']} "
-            f"!= ['all_gather'] — the mesh path's one-collective "
-            f"contract changed")
+    # sums), nothing else, in that order.  The cache-aware dispatch is
+    # held to the SAME schedule — residency must not change what
+    # crosses the ICI (no axis_index-based masking, no extra gather of
+    # the resident head).
+    for sh_name in ("sharded-mesh2", "sharded-mesh2-cached"):
+        sh = manifest["variants"].get(sh_name)
+        if sh is not None and sh["collectives"] != ["all_gather"]:
+            problems.append(
+                f"{sh_name}: collective schedule {sh['collectives']} "
+                f"!= ['all_gather'] — the mesh path's one-collective "
+                f"contract changed")
     return manifest, problems
 
 

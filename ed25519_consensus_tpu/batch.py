@@ -390,16 +390,20 @@ class StagedBatch:
       capture them; device staging then falls back to affine)."""
 
     __slots__ = ("coeffs", "coeff_shifts", "z_blob", "raw_points",
-                 "enc32", "hints")
+                 "enc32", "hints", "keyset_blob")
 
     def __init__(self, coeffs, coeff_shifts, z_blob, raw_points,
-                 enc32=None, hints=None):
+                 enc32=None, hints=None, keyset_blob=None):
         self.coeffs = coeffs
         self.coeff_shifts = coeff_shifts
         self.z_blob = z_blob
         self.raw_points = raw_points
         self.enc32 = enc32
         self.hints = hints
+        # The canonical keyset blob (32-byte key encodings in group-id
+        # order) — the content address of the device operand cache
+        # (devcache.py); None on paths that did not capture it.
+        self.keyset_blob = keyset_blob
 
     @property
     def n_sigs(self) -> int:
@@ -415,6 +419,72 @@ class StagedBatch:
         every coefficient exceeding 128 bits (what device_operands
         emits)."""
         return self.n_terms + sum(1 for c in self.coeffs if c >> 128)
+
+    @property
+    def n_cached_terms(self) -> int:
+        """Device term count under the cache-aware ALWAYS-SPLIT layout
+        (device_operands_cached): every coefficient contributes a
+        split-high term whether or not it exceeds 128 bits, so the head
+        width is a pure function of the keyset and the resident head
+        tensor stays byte-identical batch after batch."""
+        return 2 * len(self.coeffs) + self.n_sigs
+
+    def head_tensor(self) -> "np.ndarray":
+        """The keyset HEAD operand tensor, (4, NLIMBS, 2·n_coeff) int16
+        extended limbs for [B, A_1..A_m, [2^128]B, [2^128]A_1..A_m] —
+        what the device operand cache pins (hash over these exact
+        bytes) and keeps resident.  A pure function of the keyset:
+        coefficient points come from the deterministic decompression
+        rows, split-high points from the per-key shift cache."""
+        from .ops import limbs
+
+        n_coeff = len(self.coeffs)
+        coeff_pts = limbs.pack_points_from_raw(self.raw_points[:n_coeff])
+        shift_pts = limbs.pack_point_batch(
+            [sp[0] for sp in self.coeff_shifts]).astype(np.int16)
+        return np.ascontiguousarray(
+            np.concatenate([coeff_pts, shift_pts], axis=-1))
+
+    def device_operands_cached(self, pad_fn):
+        """Cache-aware device operands for a RESIDENT keyset: the
+        digit planes for ALL lanes (the always-split head layout —
+        ~17 B/term packed, the only bytes the head terms put on the
+        wire) plus the per-signature compressed R wire.  The head
+        POINT bytes are not built here at all: the dispatch reads them
+        from the resident entry (ops.msm.dispatch_window_sums_many_cached).
+
+        Layout (must match head_tensor column order): lanes
+        [0, n_coeff) carry the low-128-bit coefficient digits,
+        [n_coeff, 2·n_coeff) the high digits against the split points
+        (zero digits for coefficients under 2^128 — [0]P contributes
+        the identity under the complete addition law, so the fixed
+        layout is verdict-neutral), then the blinder digits on the R
+        lanes.  `pad_fn` maps n_cached_terms to the padded TOTAL lane
+        count; returns (digits, rwire) with rwire (33, N − 2·n_coeff)."""
+        from .ops import limbs
+
+        mask = (1 << 128) - 1
+        n_coeff = len(self.coeffs)
+        n_head = 2 * n_coeff
+        n = n_head + self.n_sigs
+        N = pad_fn(n)
+        digits = np.zeros((limbs.NWINDOWS, N), dtype=np.int8)
+        digits[:, :n_coeff] = limbs.pack_scalar_windows(
+            [c & mask for c in self.coeffs])
+        digits[:, n_coeff:n_head] = limbs.pack_scalar_windows(
+            [c >> 128 for c in self.coeffs])
+        if self.n_sigs:
+            zb = np.frombuffer(self.z_blob, dtype=np.uint8).reshape(
+                self.n_sigs, 16
+            )
+            digits[:, n_head:n] = limbs.pack_u128_windows(zb)
+        if _device_digit_wire() == "packed":
+            digits = limbs.pack_digit_planes(digits)
+        m = n_coeff - 1  # distinct keys among the coefficient terms
+        w = limbs.identity_wire_batch(N - n_head)
+        w[:32, : self.n_sigs] = self.enc32[m:].T
+        w[32, : self.n_sigs] = self.hints[m:]
+        return digits, w
 
     def host_msm(self):
         """The host-backend MSM over the staged terms (native C++ Straus
@@ -610,6 +680,24 @@ class Verifier:
         the union invalid — same all-or-nothing semantics as a poison
         entry, resolved per-batch by the usual bisection)."""
         self._invalid = str(reason)
+        # Out-of-band invalidation also bumps the device operand cache
+        # EPOCH: whatever prompted the caller to distrust queued data
+        # must not leave stale keyset operands resident (a stale-epoch
+        # hit restages from scratch and rebuilds under the new epoch —
+        # see devcache.py; tests pin that verdicts are unchanged).
+        from . import devcache as _devcache_mod
+
+        _devcache_mod.default_cache().bump_epoch("verifier-invalidate")
+
+    def _canonical_keyset_blob(self) -> "bytes | None":
+        """The canonical keyset blob (32-byte key encodings in group-id
+        order) WITHOUT staging: the devcache content address, used by
+        the routing layer's cache-temperature probe.  Reads the
+        internal key index (or the internal map view) — never exposes
+        the coalescing map."""
+        if self._buffers_live():
+            return b"".join(k.to_bytes() for k in self._key_index)
+        return b"".join(k.to_bytes() for k in self._materialized())
 
     @property
     def invalid_reason(self) -> "str | None":
@@ -792,7 +880,9 @@ class Verifier:
         n = self.batch_size
         keys = list(self._key_index)  # vk_bytes in group-id order
         m = len(keys)
-        blob = b"".join([k.to_bytes() for k in keys] + [self._r_buf])
+        key_parts = [k.to_bytes() for k in keys]
+        keyset_blob = b"".join(key_parts)
+        blob = keyset_blob + bytes(self._r_buf)
         raw, ok, hints = native.decompress_batch_buffer(
             blob, m + n, return_hints=True)
         if not ok.all():
@@ -838,6 +928,7 @@ class Verifier:
             raw_points=raw_points,
             enc32=enc32,
             hints=hints,
+            keyset_blob=keyset_blob,
         )
 
     def _stage_grouped(self, rng) -> "StagedBatch":
@@ -858,6 +949,7 @@ class Verifier:
         # One batched (native if available, exact either way) decompression
         # of all m keys and n R values into a raw coordinate buffer.
         parts = [vkb.to_bytes() for vkb, _ in groups]
+        keyset_blob = b"".join(parts)
         for _, sigs in groups:
             parts.extend(sig.R_bytes for _, sig in sigs)
         blob = b"".join(parts)
@@ -925,6 +1017,7 @@ class Verifier:
             raw_points=raw_points,
             enc32=enc32,
             hints=hints,
+            keyset_blob=keyset_blob,
         )
 
     # -- verification ------------------------------------------------------
@@ -1078,6 +1171,7 @@ class Verifier:
 # fault-injection seam at the device dispatch boundary.  Back-compat:
 # the old list names still resolve through the module __getattr__ shim
 # at the bottom of this file, as live views of the default-mesh health.
+from . import devcache as _devcache  # noqa: E402  (lane residency)
 from . import faults as _faults  # noqa: E402  (belongs with the lane)
 from . import health as _health  # noqa: E402
 from . import routing as _routing  # noqa: E402
@@ -1230,11 +1324,18 @@ class _DeviceLane:
     def healthy(self) -> bool:
         return self._thread.is_alive() and not self._abandoned
 
-    def submit(self, digits, pts) -> int:
+    def submit(self, digits, pts, cached=None) -> int:
+        """Queue one chunk dispatch.  Cold path: `digits`/`pts` are the
+        full staged operands.  Cached path (`cached` = the looked-up
+        devcache ResidentKeyset): `pts` is the per-signature R wire and
+        `digits` is either the full-lane digit planes (single device)
+        or a `(head_digits, r_digits)` pair (mesh lane) — the resident
+        head tensor itself never rides the queue; the worker fetches
+        the committed device array from the entry."""
         with self._cv:
             cid = self._next_id
             self._next_id += 1
-        self._q.put((cid, digits, pts))
+        self._q.put((cid, digits, pts, cached))
         return cid
 
     def discard(self, cid: int) -> None:
@@ -1302,7 +1403,7 @@ class _DeviceLane:
             item = self._q.get()
             if item is None:
                 return
-            cid, digits, pts = item
+            cid, digits, pts, cached = item
             with self._cv:
                 if cid in self._discarded:
                     # caller already decided on the host (e.g. a leftover
@@ -1318,13 +1419,41 @@ class _DeviceLane:
                     t_call = clock.monotonic()
                     with self._cv:
                         self._started[cid] = t_call
-                    if self._mesh > 1:
+                    if cached is not None and self._mesh > 1:
                         from .parallel import sharded_msm as _sh
+
+                        dh, dr = digits
+                        lanes_key = dh.shape[2] + dr.shape[2]
+                        n_batches = dr.shape[0]
+
+                        def _call(sh=_sh, dh=dh, dr=dr):
+                            head = cached.device_ref(self._mesh)
+                            return np.asarray(
+                                sh.sharded_window_sums_many_cached(
+                                    dh, dr, head, pts, self._mesh,
+                                    clock=clock))
+                    elif cached is not None:
+                        lanes_key = digits.shape[2]
+                        n_batches = digits.shape[0]
+
+                        def _call():
+                            head = cached.device_ref(0)
+                            return np.asarray(
+                                _msm.dispatch_window_sums_many_cached(
+                                    digits, head, pts))
+                    elif self._mesh > 1:
+                        from .parallel import sharded_msm as _sh
+
+                        lanes_key = digits.shape[2]
+                        n_batches = digits.shape[0]
 
                         def _call(sh=_sh):
                             return np.asarray(sh.sharded_window_sums_many(
                                 digits, pts, self._mesh, clock=clock))
                     else:
+                        lanes_key = digits.shape[2]
+                        n_batches = digits.shape[0]
+
                         def _call():
                             return np.asarray(
                                 _msm.dispatch_window_sums_many(digits, pts))
@@ -1336,9 +1465,12 @@ class _DeviceLane:
                         _faults.SITE_LANE, _call, mesh=self._mesh,
                         clock=clock))
                 # Fetch done ⇒ any first-compile for this shape is over:
-                # subsequent calls are held to the normal deadline.
-                _msm.mark_shape_completed(digits.shape[0], digits.shape[2],
-                                          self._mesh)
+                # subsequent calls are held to the normal deadline.  The
+                # cached dispatch is a DIFFERENT executable at the same
+                # lane count, so it completes its own shape key.
+                _msm.mark_shape_completed(n_batches, lanes_key,
+                                          self._mesh,
+                                          cached=cached is not None)
             except _faults.LaneDeathSignal:
                 # Injected mid-flight thread death: exit WITHOUT reporting
                 # a result or clearing _started — callers see an in-flight
@@ -1679,6 +1811,20 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             last_run_stats.update(stats)
             return verdicts
 
+    # Cache temperature (devcache.py): is the dominant keyset of this
+    # call device-resident?  A hot keyset ships only digits + R wire,
+    # which lowers the effective N* crossover (routing.py hot_scale) —
+    # and the probe is recorded in last_run_stats["devcache"] so the
+    # routing decision's inputs are auditable per call.  probe() is
+    # non-mutating: it never perturbs the hit/miss stream.
+    devcache_cache = _devcache.default_cache()
+    if verifiers and devcache_cache.enabled:
+        _v_big = max(verifiers, key=_routing.estimate_device_terms)
+        _blob = _v_big._canonical_keyset_blob()
+        devcache_probe = devcache_cache.probe(
+            _devcache.keyset_digest(_blob) if _blob else None)
+    else:
+        devcache_probe = devcache_cache.probe(None)
     if mesh is None:
         # AUTO routing (routing.py; VERDICT r5 next-round #6): select
         # the mesh lane only when the estimated per-batch term count of
@@ -1688,7 +1834,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         pol = policy if policy is not None else _routing.default_policy()
         est = (max(_routing.estimate_device_terms(v)
                    for v in verifiers) if verifiers else 0)
-        mesh = pol.choose_mesh(est, health=health)
+        mesh = pol.choose_mesh(est, health=health,
+                               devcache_hot=devcache_probe["hit"])
     # mesh <= 1 is single-device dispatch: normalize EARLY so the lane,
     # the health object, the shard padding, and the shape-completed
     # grace keys all agree across call sites.
@@ -1717,6 +1864,11 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # corruption signal operators should alert on.
         "device_rejects_confirmed": 0,
         "device_rejects_overturned": 0,
+        # The cache-temperature input the routing decision consumed
+        # (and the residency level at call entry), plus the number of
+        # chunk dispatches this call actually served from residency —
+        # see devcache.py.
+        "devcache": dict(devcache_probe, dispatch_hits=0),
         "seconds": 0.0,
     }
 
@@ -1775,6 +1927,31 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if len(_host_times) < 64:
             _host_times.append(now() - t0)
 
+    def resident_entry_for(staged):
+        """The devcache entry covering EVERY staged batch of a chunk,
+        or None (mixed keysets, first sight, cache off, stale/corrupt —
+        all of which mean cold staging).  Chunks are keyset-uniform in
+        the workloads the cache targets (one validator set per stream);
+        a mixed chunk simply stages cold."""
+        if not devcache_cache.enabled:
+            return None
+        blobs = {s.keyset_blob for s in staged}
+        if len(blobs) != 1 or None in blobs:
+            return None
+        if any(s.enc32 is None or s.hints is None for s in staged):
+            return None  # no compressed wire captured: cold path only
+        digest = _devcache.keyset_digest(staged[0].keyset_blob)
+        entry = devcache_cache.lookup(digest)
+        if entry is None and devcache_cache.should_build(digest):
+            # Install residency for the NEXT dispatch; THIS chunk still
+            # stages cold.  A miss — first sight, eviction, stale
+            # epoch, hash mismatch — is therefore ALWAYS the cold path
+            # (failure-model.md, cache rung 3), and a rebuilt entry
+            # first serves only through a later hit's hash re-check.
+            devcache_cache.build(digest, len(staged[0].coeffs) - 1,
+                                 staged[0].head_tensor())
+        return entry
+
     def stage_chunk(vs_idx):
         staged, idxs = [], []
         for i in vs_idx:
@@ -1784,6 +1961,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 idxs.append(i)
         if not staged:
             return None
+        entry = resident_entry_for(staged)
+        if entry is not None:
+            return stage_chunk_cached(staged, idxs, entry)
         if mesh and mesh > 1:
             from .parallel.sharded_msm import shard_pad
 
@@ -1817,7 +1997,52 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             pts = np.concatenate(
                 [pts, np.stack([ident] * nb).astype(pts.dtype)]
             )
-        return idxs, digits, pts
+        return idxs, digits, pts, None
+
+    def stage_chunk_cached(staged, idxs, entry):
+        """Operand build for a RESIDENT keyset chunk: the head point
+        bytes stay on the device (the entry's committed array); the
+        wire carries only the full-lane digit planes (~17 B/term) and
+        the per-signature R encodings (33 B/sig) — the devcache hot
+        path (VERDICT r5 ask #3's "digits + index" dispatch).  Batch
+        axis padding works exactly like the cold path: zero digits on
+        identity-encoding R lanes."""
+        from .ops import limbs
+
+        n_head = entry.n_head
+        if mesh and mesh > 1:
+            from .parallel.sharded_msm import shard_pad_cached
+
+            nr = max(shard_pad_cached(s.n_sigs, n_head, mesh)
+                     for s in staged)
+        else:
+            nr = max(msm.preferred_pad(s.n_cached_terms)
+                     for s in staged) - n_head
+        ops = [s.device_operands_cached(lambda n, nr=nr: n_head + nr)
+               for s in staged]
+        digits = np.stack([d for d, _ in ops])
+        rwire = np.stack([w for _, w in ops])
+        if digits.shape[0] < chunk:
+            nb = chunk - digits.shape[0]
+            digits = np.concatenate(
+                [digits, np.zeros((nb,) + digits.shape[1:],
+                                  digits.dtype)]
+            )
+            ident = limbs.identity_wire_batch(rwire.shape[-1])
+            rwire = np.concatenate(
+                [rwire, np.stack([ident] * nb).astype(rwire.dtype)]
+            )
+        if mesh and mesh > 1:
+            # Mesh layout: head digits land on shard 0's head lanes
+            # only (zero elsewhere — identity contributions), R digits
+            # shard over the term axis like the cold path.
+            dh = np.zeros(
+                (digits.shape[0], digits.shape[1], mesh * n_head),
+                dtype=digits.dtype)
+            dh[:, :, :n_head] = digits[:, :, :n_head]
+            dr = np.ascontiguousarray(digits[:, :, n_head:])
+            return idxs, (dh, dr), rwire, entry
+        return idxs, digits, rwire, entry
 
     # Work-stealing pipeline.  The device lane is ONE worker thread that
     # serializes every device-side call (launch + blocking fetch — both
@@ -1870,11 +2095,22 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         pending = stage_chunk(ch)
         if pending is None:
             return
-        idxs, digits, pts = pending
-        cid = dev.submit(digits, pts)
-        # (chunk id, real batch idxs, submit time, padded shape (B, N))
-        outstanding.append((cid, idxs, now(),
-                            digits.shape[0], digits.shape[2]))
+        idxs, digits, pts, cached = pending
+        cid = dev.submit(digits, pts, cached=cached)
+        if cached is not None:
+            stats["devcache"]["dispatch_hits"] += 1
+        # The padded shape key must match what the lane worker
+        # completes — mesh-cached digits ride as a (head, R) pair:
+        if isinstance(digits, tuple):
+            dh, dr = digits
+            padded_b, n_lanes = dr.shape[0], dh.shape[2] + dr.shape[2]
+        else:
+            padded_b, n_lanes = digits.shape[0], digits.shape[2]
+        # (chunk id, real batch idxs, submit time, padded shape (B, N),
+        #  cached? — the cached dispatch is a different executable at
+        #  the same lane count, so it carries its own compile grace)
+        outstanding.append((cid, idxs, now(), padded_b, n_lanes,
+                            cached is not None))
 
     def poll(block: bool):
         """Apply finished chunk results; returns True if progress.  On a
@@ -1882,10 +2118,10 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         nonlocal device_sick, device_failed, ema_per_batch, ema_is_prior
         progress = False
         while outstanding:
-            cid, idxs, t0, padded_b, n_lanes = outstanding[0]
+            cid, idxs, t0, padded_b, n_lanes, was_cached = outstanding[0]
             budget = max(3.0 * ema_per_batch * padded_b, 2.0)
             if ema_is_prior and not msm.shape_completed(
-                    padded_b, n_lanes, mesh or 0):
+                    padded_b, n_lanes, mesh or 0, cached=was_cached):
                 # No measurement yet AND no call for this padded shape has
                 # ever completed: the call may be sitting in a first-shape
                 # kernel compile (minutes through a remote-compile tunnel)
@@ -1935,7 +2171,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 health.note_deadline_miss()
                 _metrics.record_fault("deadline_miss")
                 dev.abandon()
-                for _, idxs2, _t, _b, _nl in outstanding:
+                for _, idxs2, _t, _b, _nl, _c in outstanding:
                     for i in idxs2:
                         host_verify_one(i)
                 outstanding.clear()
@@ -2034,9 +2270,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # stall.  Once the shape has completed once, non-hybrid reverts
         # to trusting the device (with the normal short deadline).
         grace_hybrid = (not hybrid and ema_is_prior and outstanding
-                        and not msm.shape_completed(outstanding[0][3],
-                                                    outstanding[0][4],
-                                                    mesh or 0))
+                        and not msm.shape_completed(
+                            outstanding[0][3], outstanding[0][4],
+                            mesh or 0, cached=outstanding[0][5]))
         lane_hybrid = hybrid or grace_hybrid
         # host lane: steal one batch from the tail, then re-poll
         if lane_hybrid and remaining and outstanding:
@@ -2050,7 +2286,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 # the math is identical either way.
                 stole = False
                 for ci in range(len(outstanding) - 1, -1, -1):
-                    cid, idxs, _t0, padded_b, _nl = outstanding[ci]
+                    cid, idxs, _t0, padded_b, _nl, _c = outstanding[ci]
                     undecided = [i for i in idxs if not decided[i]]
                     if undecided:
                         host_verify_one(undecided[-1])
@@ -2134,6 +2370,25 @@ def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
         msm.mark_shape_completed(dd.shape[0], dd.shape[2])
     except Exception:
         return  # warming is an optimization; the scheduler still works
+    try:
+        # Also warm the devcache hot-path executable at this shape — a
+        # DIFFERENT executable from the cold kernel at the same lane
+        # count (msm.shape_completed keys it separately), hit by any
+        # recurring-keyset stream from its second sight on.  No lock
+        # here: dispatch_window_sums_many_cached takes it itself.
+        if (_devcache.default_cache().enabled
+                and staged.enc32 is not None and staged.hints is not None):
+            head = staged.head_tensor()
+            n_head = head.shape[-1]
+            nr = msm.preferred_pad(staged.n_cached_terms) - n_head
+            dc, rw = staged.device_operands_cached(
+                lambda n, nr=nr: n_head + nr)
+            ddc = np.stack([dc] * chunk)
+            rr = np.stack([rw] * chunk)
+            np.asarray(msm.dispatch_window_sums_many_cached(ddc, head, rr))
+            msm.mark_shape_completed(chunk, ddc.shape[2], cached=True)
+    except Exception:
+        return  # same contract: cached warming is optional
 
 
 def verify_single_many(entries, rng=None) -> "list[bool]":

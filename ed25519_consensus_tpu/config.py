@@ -156,6 +156,17 @@ KNOBS: "dict[str, Knob]" = dict([
        "Device kernel selection: `pallas` (Mosaic), `xla` (scan "
        "kernel), or `auto` (Pallas on real TPU backends).",
        ("auto", "pallas", "xla")),
+    _k("ED25519_TPU_DEVCACHE", "opt-out", True,
+       "Set to 0/false/no to disable the device-resident operand "
+       "cache (recurring-keyset residency, devcache.py); cold-path "
+       "staging is then used for every dispatch."),
+    _k("ED25519_TPU_DEVCACHE_BYTES", "int", 1 << 26,
+       "Device operand cache residency budget in bytes (deterministic "
+       "LRU eviction above it); 0 also disables residency."),
+    _k("ED25519_TPU_DEVCACHE_HOT_SCALE", "float", 0.75,
+       "Factor applied to the N* crossover model's fixed cost `a` "
+       "when the dispatched keyset is device-resident (a hot keyset "
+       "lowers the effective crossover); 1.0 disables the effect."),
 ])
 
 

@@ -1,0 +1,378 @@
+"""Device-resident operand cache: content-addressed keyset residency
+for the TPU verify lane (VERDICT r5 ranked ask #3).
+
+In consensus workloads the validator keyset recurs every block, so the
+operand bytes the device lane ships for the MSM's HEAD terms — the
+basepoint/A-coefficient points and their [2^128]·P split-high partners —
+are byte-identical batch after batch.  The host path already exploits
+exactly this recurrence with the keyset-blob cache
+(`batch._keyset_blob_cache`); this module is the device lane's analog:
+
+* **Content addressing.**  An entry is keyed by SHA-256 over the
+  CANONICAL keyset blob — the 32-byte verification-key encodings
+  concatenated in group-id (first-seen) order, the same ordering
+  `Verifier._key_index` maintains and staging consumes.  Two verifiers
+  queueing the same keys in the same order hit the same entry; any
+  difference in membership or order is a different keyset.
+* **Resident value.**  The precomputed HEAD OPERAND TENSOR: a
+  `(4, NLIMBS, 2·(m+1))` int16 extended-coordinate limb tensor for
+  `[B, A_1..A_m, [2^128]B, [2^128]A_1..A_m]` — exactly the bytes
+  `StagedBatch.device_operands_cached` would otherwise ship per
+  dispatch.  The tensor is `jax.device_put` once per dispatch mode and
+  the device array handle is reused; on a hit the wire carries only
+  the per-signature scalar digits (~17 B/term packed) plus the
+  per-signature R encodings — the keyset head drops off the wire
+  entirely (see `ops.msm.dispatch_window_sums_many_cached`).
+* **Hash pinning (the consensus rule).**  Every entry stores
+  `head_hash = SHA-256(head_tensor bytes)` computed at build time from
+  bytes the HOST staged exactly; every hit re-hashes the host mirror
+  and a mismatch drops the entry and forces a full restage
+  (`devcache_restage_hash_mismatch`).  Residency is therefore
+  verdict-transparent by construction: the device either computes over
+  bytes provably identical to what cold staging would have shipped, or
+  the dispatch falls back to cold staging.  A corruption that exists
+  only in the device copy is caught one rung later by the scheduler's
+  host confirmation of device rejects (docs/failure-model.md).
+* **Budget + deterministic LRU.**  Residency is bounded by
+  `ED25519_TPU_DEVCACHE_BYTES` (host-mirror bytes; the device copy is
+  the same size per dispatch mode).  Eviction is strict
+  least-recently-USED in lookup order — deterministic, so soak replays
+  see identical hit/miss streams.
+* **Epochs.**  `bump_epoch()` invalidates every entry logically
+  without touching them (entries carry their build epoch; a
+  stale-epoch lookup drops the entry and restages).  It is wired to
+  `batch.Verifier.invalidate()` (out-of-band invalidation must not
+  leave stale operands resident) and — through the
+  `health.on_residency_drop` listener — to lane death/abandonment and
+  device errors (a dead or flapped lane drops all residency and
+  re-stages from scratch; the replacement lane's device memory owes
+  nothing to the old one's).
+
+Fault seams (`faults.SITE_DEVCACHE`): every lookup passes through
+`faults.run_device_call`, so `CorruptResidentEntry` / `EvictStorm` /
+`StaleEpochOn` plans land deterministically at this boundary.  All
+three degrade to a restage, never to a verdict (tests/test_devcache.py
+pins verdict bit-identity under each).
+
+No module-global mutable cache state: the cache is an injectable
+object (consensuslint CL004 covers this module), the process default
+living in the same `_default`-slot idiom as `routing.default_policy`.
+No clock: recency is a lookup sequence number, so the module needs no
+time source at all (CL002 trivially holds).
+"""
+
+import hashlib
+import threading
+
+from . import config as _config
+from . import faults as _faults
+from . import health as _health
+from .utils import metrics as _metrics
+
+__all__ = [
+    "ResidentKeyset", "DeviceOperandCache", "default_cache",
+    "set_default_cache", "keyset_digest",
+]
+
+
+def keyset_digest(keyset_blob: bytes) -> bytes:
+    """The content address of a canonical keyset blob (32-byte key
+    encodings concatenated in group-id order): SHA-256."""
+    return hashlib.sha256(keyset_blob).digest()
+
+
+class ResidentKeyset:
+    """One resident keyset entry: the host mirror of the precomputed
+    head operand tensor, its pinned hash, the build epoch, and the
+    per-dispatch-mode device array handles."""
+
+    __slots__ = ("digest", "n_keys", "head_tensor", "head_hash",
+                 "epoch", "nbytes", "_device_refs", "_seq")
+
+    def __init__(self, digest: bytes, n_keys: int, head_tensor,
+                 epoch: int):
+        self.digest = digest
+        self.n_keys = int(n_keys)
+        self.head_tensor = head_tensor  # (4, NLIMBS, 2*(n_keys+1)) int16
+        self.head_hash = hashlib.sha256(head_tensor.tobytes()).digest()
+        self.epoch = int(epoch)
+        self.nbytes = int(head_tensor.nbytes)
+        self._device_refs = {}  # mesh key -> committed device array
+        self._seq = 0  # last-used lookup sequence (cache-maintained)
+
+    @property
+    def n_head(self) -> int:
+        """Head term count: coefficient terms + split-high terms."""
+        return 2 * (self.n_keys + 1)
+
+    def recheck(self) -> bool:
+        """True iff the host mirror still hashes to the pinned value —
+        the per-hit consensus gate between residency and dispatch."""
+        return hashlib.sha256(
+            self.head_tensor.tobytes()).digest() == self.head_hash
+
+    def device_ref(self, mesh: int = 0):
+        """The committed device array for this entry under a dispatch
+        mode, `jax.device_put` on first use and reused thereafter, so a
+        steady-state hit pays zero H2D for the head.  Callers hold the
+        device-call lock (the lane worker does); errors propagate to
+        the worker's supervision and become an ordinary device-error
+        fallback."""
+        key = _health.normalize_mesh(mesh)
+        ref = self._device_refs.get(key)
+        if ref is None:
+            import jax
+
+            ref = jax.device_put(self.head_tensor)
+            self._device_refs[key] = ref
+        return ref
+
+
+class DeviceOperandCache:
+    """Content-addressed residency for recurring keysets (module
+    docstring).  Thread-safe; injectable (tests construct their own,
+    the scheduler uses `default_cache()`).
+
+    POLICY mirror of the host split cache: an entry is built only at a
+    keyset's SECOND sight, so one-shot fresh-keyset workloads never pay
+    the build; consensus streams (recurring validator sets) become
+    resident at their second dispatch (which itself still stages cold —
+    a miss is always the cold path) and serve from residency from the
+    third on."""
+
+    def __init__(self, budget_bytes: "int | None" = None,
+                 enabled: "bool | None" = None):
+        if enabled is None:
+            enabled = _config.get("ED25519_TPU_DEVCACHE")
+        if budget_bytes is None:
+            budget_bytes = _config.get("ED25519_TPU_DEVCACHE_BYTES")
+        self.budget_bytes = int(budget_bytes)
+        self.enabled = bool(enabled) and self.budget_bytes > 0
+        self._lock = threading.Lock()
+        self._entries: "dict[bytes, ResidentKeyset]" = {}
+        self._seen: "set[bytes]" = set()
+        self._seen_max = 1 << 16
+        self._epoch = 0
+        self._lookup_seq = 0
+        self.counters = {
+            "hits": 0, "misses": 0, "evictions": 0,
+            "restage_hash_mismatch": 0, "stale_epoch": 0,
+            "builds": 0, "drops": 0,
+        }
+
+    # -- epoch / residency lifecycle --------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def bump_epoch(self, reason: str = "invalidated") -> int:
+        """Logically invalidate every resident entry: entries carry
+        their build epoch, and a lookup under a newer epoch restages.
+        Wired to `Verifier.invalidate()` and the devcache fault seam."""
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def drop_all(self, reason: str = "dropped") -> int:
+        """Drop every resident entry NOW (lane death/flap, evict-storm
+        fault).  Returns the number dropped."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.counters["drops"] += n
+        if n:
+            _metrics.record_fault("devcache_drop_all")
+        self._publish()
+        return n
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- lookup / build ----------------------------------------------------
+
+    def probe(self, digest: "bytes | None") -> "dict":
+        """Non-mutating cache-temperature read for the routing layer:
+        {"hit": bool, "resident_bytes": int}.  Counts nothing, touches
+        no recency — routing must not perturb the hit/miss stream."""
+        with self._lock:
+            e = self._entries.get(digest) if digest is not None else None
+            hot = (e is not None and e.epoch == self._epoch
+                   and self.enabled)
+            return {"hit": bool(hot),
+                    "resident_bytes": sum(
+                        x.nbytes for x in self._entries.values())}
+
+    def lookup(self, digest: bytes) -> "ResidentKeyset | None":
+        """The dispatch-time lookup: returns a hash-rechecked, current-
+        epoch entry or None (miss / stale / corrupt — all of which mean
+        "stage cold").  Passes through the SITE_DEVCACHE fault seam;
+        publishes the hit/miss/evict/bytes gauges."""
+        if not self.enabled:
+            return None
+        entry = _faults.run_device_call(
+            _faults.SITE_DEVCACHE, lambda: self._lookup_locked(digest),
+            payload=self)
+        if entry is not None:
+            # Consensus gate — AFTER the fault seam, so an injected (or
+            # real) host-mirror corruption is caught here, before any
+            # dispatch could use the rotten bytes.
+            if entry.epoch != self._current_epoch():
+                self._drop(digest, "stale_epoch")
+                _metrics.record_fault("devcache_stale_epoch")
+                entry = None
+            elif not entry.recheck():
+                self._drop(digest, "restage_hash_mismatch")
+                _metrics.record_fault("devcache_restage_hash_mismatch")
+                entry = None
+        with self._lock:
+            self.counters["hits" if entry is not None else "misses"] += 1
+        self._publish()
+        return entry
+
+    def _current_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def _lookup_locked(self, digest):
+        with self._lock:
+            e = self._entries.get(digest)
+            if e is not None:
+                self._lookup_seq += 1
+                e._seq = self._lookup_seq
+            return e
+
+    def _drop(self, digest: bytes, counter: str) -> None:
+        with self._lock:
+            if self._entries.pop(digest, None) is not None:
+                self.counters[counter] += 1
+
+    def should_build(self, digest: bytes) -> bool:
+        """Second-sight build policy: False (and remember the sighting)
+        the first time a keyset is asked about, True from then on."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if digest in self._seen:
+                return True
+            if len(self._seen) >= self._seen_max:
+                self._seen.clear()
+            self._seen.add(digest)
+            return False
+
+    def build(self, digest: bytes, n_keys: int,
+              head_tensor) -> "ResidentKeyset | None":
+        """Install a resident entry built from HOST-staged bytes
+        (`StagedBatch.head_tensor()`), evicting least-recently-used
+        entries past the byte budget.  Returns the entry, or None when
+        the tensor alone exceeds the whole budget (a keyset too large
+        to ever be resident — cold staging is the steady state then)."""
+        if not self.enabled:
+            return None
+        import numpy as np
+
+        head_tensor = np.ascontiguousarray(head_tensor)
+        if head_tensor.nbytes > self.budget_bytes:
+            return None
+        evicted = 0
+        with self._lock:
+            entry = ResidentKeyset(digest, n_keys, head_tensor,
+                                   self._epoch)
+            self._lookup_seq += 1
+            entry._seq = self._lookup_seq
+            self._entries[digest] = entry
+            # Deterministic LRU: evict strictly by last-used sequence
+            # until the mirror fits the budget again.
+            while (sum(e.nbytes for e in self._entries.values())
+                   > self.budget_bytes and len(self._entries) > 1):
+                victim = min(self._entries.values(),
+                             key=lambda e: e._seq)
+                del self._entries[victim.digest]
+                self.counters["evictions"] += 1
+                evicted += 1
+            self.counters["builds"] += 1
+        if evicted:
+            _metrics.record_fault("devcache_evict", evicted)
+        self._publish()
+        return entry
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": sum(
+                    e.nbytes for e in self._entries.values()),
+                "resident_keysets": len(self._entries),
+                "epoch": self._epoch,
+                **self.counters,
+            }
+
+    def _publish(self) -> None:
+        """Mirror the levels into the process gauge registry
+        (utils.metrics): devcache_hits/misses/evictions/resident_bytes
+        and friends — what soak tooling and operators watch."""
+        st = self.stats()
+        _metrics.set_gauges({
+            "devcache_hits": st["hits"],
+            "devcache_misses": st["misses"],
+            "devcache_evictions": st["evictions"],
+            "devcache_resident_bytes": st["resident_bytes"],
+            "devcache_resident_keysets": st["resident_keysets"],
+            "devcache_restages": (st["restage_hash_mismatch"]
+                                  + st["stale_epoch"]),
+            "devcache_epoch": st["epoch"],
+        })
+
+    def __repr__(self):
+        st = self.stats()
+        return (f"DeviceOperandCache(enabled={st['enabled']}, "
+                f"resident={st['resident_keysets']} keysets / "
+                f"{st['resident_bytes']}B of {st['budget_bytes']}B, "
+                f"epoch={st['epoch']}, hits={st['hits']}, "
+                f"misses={st['misses']})")
+
+
+# -- process default (same injectable-singleton idiom as routing.py) ------
+
+_default = [None]
+_default_lock = threading.Lock()
+
+
+def default_cache() -> DeviceOperandCache:
+    """The process default cache, constructed lazily so env knobs set
+    before first use take effect.  Tests inject their own instance with
+    `set_default_cache` (or construct one and pass it around)."""
+    with _default_lock:
+        if _default[0] is None:
+            _default[0] = DeviceOperandCache()
+        return _default[0]
+
+
+def set_default_cache(cache: "DeviceOperandCache | None") -> None:
+    """Replace the process default (None resets to a fresh env-derived
+    instance on next use)."""
+    with _default_lock:
+        _default[0] = cache
+
+
+# Lane death / abandonment drops all residency: a dead or flapped lane
+# re-stages from scratch (the replacement lane's device memory owes
+# nothing to the old one's).  Registered once at import; the listener
+# runs OUTSIDE health's lock (health.py contract).
+def _on_residency_drop(reason: str) -> None:
+    with _default_lock:
+        cache = _default[0]
+    if cache is not None:
+        cache.drop_all(reason)
+
+
+_health.register_residency_drop_listener(_on_residency_drop)

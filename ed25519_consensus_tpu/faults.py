@@ -53,15 +53,21 @@ from contextlib import contextmanager
 import numpy as np
 
 __all__ = [
-    "SITE_LANE", "SITE_SHARDED", "InjectedFault", "LaneDeathSignal",
+    "SITE_LANE", "SITE_SHARDED", "SITE_DEVCACHE", "InjectedFault",
+    "LaneDeathSignal",
     "Fault", "ErrorOn", "StallFor", "FlappingLink", "CorruptSum",
-    "KillLane", "FaultPlan", "randomized_plan", "storm_plan",
+    "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
+    "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
 ]
 
 SITE_LANE = "lane"
 SITE_SHARDED = "sharded"
+# The device operand cache's lookup boundary (devcache.py): "call
+# index" counts cache lookups, and ctx.payload is the cache object
+# itself, so cache faults can evict/corrupt/stale deterministically.
+SITE_DEVCACHE = "devcache"
 
 
 class InjectedFault(RuntimeError):
@@ -216,15 +222,71 @@ class KillLane(Fault):
             f"injected lane death (call={ctx.index})")
 
 
-class _CallContext:
-    __slots__ = ("plan", "site", "index", "mesh", "clock")
+class CorruptResidentEntry(Fault):
+    """Flip bytes in the looked-up resident keyset entry's HOST mirror
+    (deterministically from the plan seed) — modelling rotted resident
+    operand bytes.  The devcache hash re-check runs AFTER this seam on
+    every hit, so the corruption is caught before dispatch and forces a
+    full restage; corruption that only exists on-device is covered by
+    the scheduler's host confirmation of device rejects (the existing
+    CorruptSum ladder).  Either way it can never become a verdict."""
 
-    def __init__(self, plan, site, index, mesh, clock):
+    def __init__(self, on=0, flips: int = 4):
+        super().__init__(on=on, site=SITE_DEVCACHE)
+        self.flips = int(flips)
+
+    def after(self, ctx, out):
+        # `out` is the looked-up ResidentKeyset (or None on a miss);
+        # the host mirror is a writable numpy array by contract.
+        if out is not None:
+            rng = random.Random(_stable_seed(
+                ctx.plan.seed, ctx.site, ctx.index, "resident"))
+            flat = out.head_tensor.reshape(-1)
+            for _ in range(max(1, self.flips)):
+                flat[rng.randrange(flat.size)] ^= 1 << rng.randrange(8)
+        return out
+
+
+class EvictStorm(Fault):
+    """Drop EVERY resident entry at the faulted lookup (ctx.payload is
+    the cache) — the shape of memory-pressure eviction hitting exactly
+    when the entry was about to be used.  The lookup becomes a miss and
+    the batch restages from scratch: verdict-neutral by construction."""
+
+    def __init__(self, on=0):
+        super().__init__(on=on, site=SITE_DEVCACHE)
+
+    def before(self, ctx):
+        if ctx.payload is not None:
+            ctx.payload.drop_all("evict-storm fault")
+
+
+class StaleEpochOn(Fault):
+    """Bump the cache epoch at the faulted lookup, so the entry about
+    to be returned is stale (as if an out-of-band invalidation landed
+    between staging and dispatch).  The cache treats a stale-epoch hit
+    as a miss and restages."""
+
+    def __init__(self, on=0):
+        super().__init__(on=on, site=SITE_DEVCACHE)
+
+    def before(self, ctx):
+        if ctx.payload is not None:
+            ctx.payload.bump_epoch("stale-epoch fault")
+
+
+class _CallContext:
+    __slots__ = ("plan", "site", "index", "mesh", "clock", "payload")
+
+    def __init__(self, plan, site, index, mesh, clock, payload=None):
         self.plan = plan
         self.site = site
         self.index = index
         self.mesh = mesh
         self.clock = clock
+        # Site-specific hook object (SITE_DEVCACHE passes the cache so
+        # evict/stale faults can act on it); None at the lane seams.
+        self.payload = payload
 
 
 class FaultPlan:
@@ -275,11 +337,12 @@ class FaultPlan:
             self._counts[site] = i + 1
             return i
 
-    def run(self, site: str, fn, *, mesh: int = 0, clock=None):
+    def run(self, site: str, fn, *, mesh: int = 0, clock=None,
+            payload=None):
         idx = self._next_index(site)
         fired = [f for f in self.faults
                  if f.site == site and f.fires_on(idx)]
-        ctx = _CallContext(self, site, idx, mesh, clock)
+        ctx = _CallContext(self, site, idx, mesh, clock, payload)
         if fired:
             with self._lock:
                 self._log.extend((site, idx, f.kind()) for f in fired)
@@ -355,6 +418,32 @@ def storm_plan(seed: int, kind: str, at: int = 0, length: int = 1,
     return FaultPlan(faults, seed=seed)
 
 
+def devcache_plan(seed: int, kind: str, at: int = 0,
+                  length: int = 1, flips: int = 4) -> FaultPlan:
+    """A fault window over the device-operand-cache LOOKUP stream
+    (SITE_DEVCACHE; indices count lookups, not device calls):
+
+    * ``"corrupt"`` — flip bytes in the looked-up entry's host mirror
+      (caught by the per-hit hash re-check, forces a full restage);
+    * ``"evict"``   — drop all residency at the faulted lookups (an
+      eviction storm; lookups become misses);
+    * ``"stale"``   — bump the cache epoch at the faulted lookups (the
+      entry about to be used goes stale and restages).
+
+    Same replay property as every other plan: decisions are pure
+    functions of (seed, site, call index)."""
+    window = range(at, at + max(1, length))
+    if kind == "corrupt":
+        faults = [CorruptResidentEntry(on=window, flips=flips)]
+    elif kind == "evict":
+        faults = [EvictStorm(on=window)]
+    elif kind == "stale":
+        faults = [StaleEpochOn(on=window)]
+    else:
+        raise ValueError(f"unknown devcache fault kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
 # -- the process-wide injection point -------------------------------------
 
 _active = [None]
@@ -392,10 +481,13 @@ def injected(plan: FaultPlan):
         uninstall()
 
 
-def run_device_call(site: str, fn, *, mesh: int = 0, clock=None):
+def run_device_call(site: str, fn, *, mesh: int = 0, clock=None,
+                    payload=None):
     """The seam the dispatch boundaries call: apply the active plan's
-    faults for this (site, call) around `fn`.  No plan → `fn()`."""
+    faults for this (site, call) around `fn`.  No plan → `fn()`.
+    `payload` is the site-specific hook object (the devcache lookup
+    seam passes the cache itself)."""
     plan = _active[0]
     if plan is None:
         return fn()
-    return plan.run(site, fn, mesh=mesh, clock=clock)
+    return plan.run(site, fn, mesh=mesh, clock=clock, payload=payload)

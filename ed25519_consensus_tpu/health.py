@@ -44,7 +44,8 @@ import time
 __all__ = [
     "Clock", "FakeClock", "SYSTEM_CLOCK", "DeviceHealth", "Backoff",
     "normalize_mesh", "health_for", "reset_all", "any_lane_stuck",
-    "set_any_lane_stuck",
+    "set_any_lane_stuck", "register_residency_drop_listener",
+    "notify_residency_drop",
 ]
 
 
@@ -111,6 +112,39 @@ class FakeClock(Clock):
 # native thread at interpreter finalization) is process-scoped.
 _lane_stuck_latch = [False]
 _latch_lock = threading.Lock()
+
+# Residency-drop listeners (round 7, device operand cache): a lane
+# abandoned mid-call may leave device-resident operand arrays behind on
+# a runtime whose state is no longer trusted, so `mark_lane_stuck` —
+# the one canonical lane-death/abandonment transition — notifies every
+# registered listener (devcache registers its drop_all).  Listeners run
+# OUTSIDE any DeviceHealth lock (module contract: no method calls out
+# of the module while holding a lock) and must not raise.  The list is
+# append-only process wiring, not cache state (CL004-reviewed).
+_residency_listeners = []
+
+
+def register_residency_drop_listener(fn) -> None:
+    """Register `fn(reason: str)` to run whenever a lane is marked
+    stuck (lane death / abandonment).  Registration is idempotent by
+    identity."""
+    with _latch_lock:
+        if fn not in _residency_listeners:
+            _residency_listeners.append(fn)
+
+
+def notify_residency_drop(reason: str) -> None:
+    """Run every residency-drop listener (outside all health locks).
+    Listener failures are deliberately not allowed to break the health
+    transition that triggered them — dropping residency is an
+    optimization-state cleanup, never verdict-relevant."""
+    with _latch_lock:
+        listeners = list(_residency_listeners)
+    for fn in listeners:
+        try:
+            fn(reason)
+        except Exception:
+            pass
 
 
 class DeviceHealth:
@@ -204,6 +238,10 @@ class DeviceHealth:
             self._lane_stuck = True
         with _latch_lock:
             _lane_stuck_latch[0] = True
+        # Outside both locks (module contract): a dead/abandoned lane
+        # drops all device operand residency — the replacement lane
+        # restages from scratch.
+        notify_residency_drop(f"lane-stuck mesh={self.mesh}")
 
     def reset(self) -> None:
         """Clear transient health state (cooldowns, pauses, streak,

@@ -110,13 +110,19 @@ _shapes_completed = set()
 
 
 def mark_shape_completed(n_batches: int, n_lanes: int,
-                         mesh: int = 0) -> None:
-    _shapes_completed.add((int(n_batches), int(n_lanes), int(mesh or 0)))
+                         mesh: int = 0, cached: bool = False) -> None:
+    _shapes_completed.add((int(n_batches), int(n_lanes), int(mesh or 0),
+                           bool(cached)))
 
 
-def shape_completed(n_batches: int, n_lanes: int, mesh: int = 0) -> bool:
-    return (int(n_batches), int(n_lanes),
-            int(mesh or 0)) in _shapes_completed
+def shape_completed(n_batches: int, n_lanes: int, mesh: int = 0,
+                    cached: bool = False) -> bool:
+    """`cached` keys the devcache dispatch separately: the cache-aware
+    kernel entry is a DIFFERENT executable from the cold-path kernel at
+    the same (B, N), so its first call deserves its own compile
+    grace."""
+    return (int(n_batches), int(n_lanes), int(mesh or 0),
+            bool(cached)) in _shapes_completed
 
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
@@ -479,6 +485,51 @@ def dispatch_window_sums(digits, points):
     combine_window_sums accept the leading singleton) with its D2H copy
     already in flight."""
     return dispatch_window_sums_many(digits[None], points[None])
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_assemble_cached(n_batches: int, n_head: int, n_r: int):
+    """The cache-aware operand assembler (round 7, devcache.py): build
+    the full extended-coordinate point batch ON DEVICE from
+
+    * `head`  — the RESIDENT keyset head tensor, (4, NLIMBS, n_head)
+      int16 extended limbs for [B, A_1..A_m, [2^128]B, [2^128]A_..]
+      (already committed to the device by devcache; zero H2D), and
+    * `rwire` — the per-signature compressed wire, (B, 33, n_r) uint8
+      (the only point bytes that cross the link on a hit).
+
+    The head is shared by every batch in the chunk (the cached dispatch
+    requires one keyset per chunk), so it broadcasts across the batch
+    axis; output is (B, 4, NLIMBS, n_head + n_r) int16, the extended
+    wire `dispatch_window_sums_many` consumes.  Integer-only end to end
+    (audited: `xla-devcache-assemble` in the jaxpr manifest)."""
+    ensure_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    def f(head, rwire):
+        r_pts = expand_points(rwire, "compressed")  # (B,4,NLIMBS,n_r)
+        h = jnp.broadcast_to(
+            head[None].astype(jnp.int16),
+            (n_batches, 4, NLIMBS, n_head))
+        return jnp.concatenate([h, r_pts.astype(jnp.int16)], axis=-1)
+
+    return jax.jit(f)
+
+
+def dispatch_window_sums_many_cached(digits, head, rwire):
+    """The hot-path dispatch for a resident keyset: digits
+    (B, PACKED_WINDOWS, N) for ALL N = n_head + n_r lanes (~17 B/term —
+    the only per-head-term bytes on the wire), `head` the entry's
+    committed device array, `rwire` (B, 33, n_r) the per-signature R
+    encodings.  Assembles the extended point batch on device, then runs
+    the SAME kernel dispatch as the cold path — so the window-sum math
+    (and therefore every verdict) is identical to full staging by
+    construction; only where the head bytes came from differs."""
+    with DEVICE_CALL_LOCK:
+        pts = _compiled_assemble_cached(
+            rwire.shape[0], head.shape[-1], rwire.shape[-1])(head, rwire)
+        return dispatch_window_sums_many(digits, pts)
 
 
 def device_msm_async(scalars, points, shifts=None) -> PendingMSM:

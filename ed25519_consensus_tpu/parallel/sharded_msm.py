@@ -151,6 +151,125 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_kernel_many_cached(n_devices: int, n_batches: int,
+                                         n_head: int, r_per_dev: int,
+                                         nwin: int,
+                                         dwire: str = "packed"):
+    """The mesh lane's cache-aware dispatch (round 7, devcache.py):
+    per-shard residency of the keyset head under the sharded MSM.
+
+    Global inputs:
+
+    * head_digits (B, PW, D·n_head) — the head-term digit planes, laid
+      out so shard k receives columns [k·n_head, (k+1)·n_head): only
+      shard 0's slice carries real digits, every other shard's slice is
+      ZERO (host-built), so the head terms are counted exactly once in
+      the all-gathered fold — a zero digit on any point contributes the
+      identity under the complete addition law.  No axis_index, no
+      masking primitive: the collective schedule stays exactly
+      ['all_gather'] (manifest variant `sharded-mesh2-cached`).
+    * r_digits (B, PW, NR), rwire (B, 33, NR) — the per-signature digit
+      planes and R encodings, sharded over the term axis like the cold
+      path's operands.
+    * head (4, NLIMBS, n_head) int16 — the RESIDENT keyset head tensor,
+      REPLICATED to every shard (per-shard residency; device_put once).
+
+    Each shard computes the local kernel over n_head + NR/D lanes; the
+    partial window sums all-gather and fold exactly like the cold
+    sharded path, so verdicts are identical by construction."""
+    msm_lib.ensure_compile_cache()
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..ops import jnp_edwards as E
+    import jax.numpy as jnp
+
+    mesh = mesh_lib.batch_mesh(n_devices)
+    axis = mesh_lib.BATCH_AXIS
+    local_kernel = msm_lib._compiled_kernel.__wrapped__(
+        n_head + r_per_dev, nwin
+    )
+
+    def shard_fn(head_digits, r_digits, head, rwire):
+        # per-device: head_digits (B, PW, n_head), r_digits (B, PW,
+        # NR/D), head (4, NLIMBS, n_head), rwire (B, 33, NR/D)
+        if dwire == "packed":
+            head_digits = msm_lib.expand_digits(head_digits)
+            r_digits = msm_lib.expand_digits(r_digits)
+        digits = jnp.concatenate([head_digits, r_digits], axis=-1)
+        r_pts = msm_lib.expand_points(rwire, "compressed")
+        h = jnp.broadcast_to(
+            head[None].astype(jnp.int16),
+            (n_batches, 4, msm_lib.NLIMBS, n_head))
+        points = jnp.concatenate(
+            [h, r_pts.astype(jnp.int16)], axis=-1)
+        part = jax.vmap(local_kernel)(digits, points)
+        part = jnp.transpose(part, (1, 2, 0, 3))  # (4, NLIMBS, B, nwin)
+        gathered = jax.lax.all_gather(part, axis)
+
+        def fold(acc, p):
+            return E.point_add(acc, p), None
+
+        out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]),
+                              gathered)
+        return jnp.transpose(out, (2, 0, 1, 3))  # (B, 4, NLIMBS, nwin)
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, None, axis),
+                  P(None, None), P(None, None, axis)),
+        out_specs=P(),
+    )
+    try:
+        fn = shard_map(shard_fn, check_vma=False, **kwargs)
+    except TypeError:
+        fn = shard_map(shard_fn, check_rep=False, **kwargs)
+    return jax.jit(fn)
+
+
+def sharded_window_sums_many_cached(head_digits, r_digits, head, rwire,
+                                    n_devices: int, clock=None):
+    """Batched cache-aware mesh dispatch (see the compiled builder):
+    returns the replicated (B, 4, NLIMBS, nwin) window sums.  Passes
+    through the SITE_SHARDED fault seam like the cold mesh dispatch —
+    the cache changes where operand bytes come from, never which seams
+    supervise the call."""
+    from .. import faults as _faults
+
+    dwire = msm_lib.digit_wire_of(r_digits)
+    nwin = msm_lib.logical_windows(r_digits)
+    n_head = head.shape[-1]
+    kernel = _compiled_sharded_kernel_many_cached(
+        n_devices, r_digits.shape[0], n_head,
+        r_digits.shape[2] // n_devices, nwin, dwire=dwire,
+    )
+    return _faults.run_device_call(
+        _faults.SITE_SHARDED,
+        lambda: kernel(head_digits, r_digits, head, rwire),
+        mesh=n_devices, clock=clock)
+
+
+def shard_pad_cached(n_sigs: int, n_head: int, n_devices: int) -> int:
+    """R-lane padding for the cached mesh dispatch: the PER-SHARD lane
+    count n_head + NR/D must satisfy the local kernel's constraint (a
+    power of two below GROUP_LANES — the stage-3 tree fold halves
+    exactly — or a GROUP_LANES multiple above it).  Returns the global
+    R lane count NR."""
+    per_dev_r = -(-max(n_sigs, 1) // n_devices)
+    lanes = n_head + per_dev_r
+    pad = 8
+    while pad < lanes:
+        pad = (pad * 2 if pad < msm_lib.GROUP_LANES
+               else pad + msm_lib.GROUP_LANES)
+    return (pad - n_head) * n_devices
+
+
 def sharded_window_sums_many(digits, pts, n_devices: int, clock=None):
     """Batched mesh dispatch (the scheduler's device-lane call when a
     mesh is configured): digits (B, nwin, N), points in any wire format

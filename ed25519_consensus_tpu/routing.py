@@ -40,6 +40,11 @@ Env knobs (config surface, SURVEY.md §5):
   override the a / b constants (seconds, seconds-per-term) for the
   default policy, e.g. after re-running the scaling lab on new
   hardware.
+* ``ED25519_TPU_DEVCACHE_HOT_SCALE`` — factor applied to `a` when the
+  dispatched keyset is resident in the device operand cache
+  (devcache.py): a hot keyset skips the head-point staging/H2D share
+  of the fixed cost, lowering the effective N*.  1.0 disables the
+  effect; a COLD cache always reproduces the unscaled r5 model.
 """
 
 import threading
@@ -105,7 +110,8 @@ class RoutingPolicy:
     def __init__(self, fixed_cost_s: float = None,
                  per_term_s: float = None,
                  min_devices: int = 2,
-                 auto_mesh: bool = None):
+                 auto_mesh: bool = None,
+                 hot_scale: float = None):
         # Env overrides come through the config.py registry: a
         # malformed ED25519_TPU_MESH_* value raises a typed ConfigError
         # HERE, at policy construction — not a bare ValueError (or a
@@ -125,31 +131,51 @@ class RoutingPolicy:
         if auto_mesh is None:
             auto_mesh = _config.get("ED25519_TPU_AUTO_MESH")
         self.auto_mesh = bool(auto_mesh)
+        self.hot_scale = (float(hot_scale) if hot_scale is not None
+                          else _config.get(
+                              "ED25519_TPU_DEVCACHE_HOT_SCALE"))
 
-    def crossover_terms(self, n_devices: int) -> float:
+    def crossover_terms(self, n_devices: int,
+                        devcache_hot: bool = False) -> float:
         """N*(D) — the per-batch term count above which a D-device
         sharded dispatch beats the single device.  Infinite for D <= 1
-        (sharding over one device can only add collective overhead)."""
+        (sharding over one device can only add collective overhead).
+
+        `devcache_hot` scales the fixed cost `a` by the policy's
+        `hot_scale` (ED25519_TPU_DEVCACHE_HOT_SCALE): when the
+        dispatched keyset is device-resident the per-call staging/H2D
+        share of `a` shrinks (the head points never cross the link), so
+        the effective crossover LOWERS — sharding starts paying off at
+        smaller batches.  A COLD keyset (devcache_hot=False, the
+        default) uses the unscaled r5 model, bit-for-bit the pre-cache
+        behavior."""
         if n_devices <= 1:
             return float("inf")
-        return self.fixed_cost_s / (
-            self.per_term_s * (1.0 - 1.0 / n_devices))
+        a = self.fixed_cost_s
+        if devcache_hot:
+            a *= self.hot_scale
+        return a / (self.per_term_s * (1.0 - 1.0 / n_devices))
 
     def choose_mesh(self, est_terms_per_batch: int,
                     n_devices: int = None,
-                    health: "_health.DeviceHealth | None" = None) -> int:
+                    health: "_health.DeviceHealth | None" = None,
+                    devcache_hot: bool = False) -> int:
         """The dispatch mode for batches of ~`est_terms_per_batch` device
         terms: the full available mesh D when sharding clears N*(D) AND
         the mesh's live health allows the device, else 0 (single-device
         lane; verify_many's own probe/health machinery still decides
         host vs device from there).  `health` defaults to the process
-        health for the candidate mesh."""
+        health for the candidate mesh.  `devcache_hot` is the
+        cache-temperature input (verify_many probes the device operand
+        cache for the call's dominant keyset and records the probe in
+        `last_run_stats["devcache"]`); see `crossover_terms`."""
         if not self.auto_mesh:
             return 0
         d = available_devices() if n_devices is None else int(n_devices)
         if d < self.min_devices:
             return 0
-        if est_terms_per_batch <= self.crossover_terms(d):
+        if est_terms_per_batch <= self.crossover_terms(
+                d, devcache_hot=devcache_hot):
             return 0
         h = health if health is not None else _health.health_for(d)
         if not h.device_allowed():

@@ -329,6 +329,12 @@ class VerifyService:
             "submitted": 0, "resolved": 0, "rejected_overloaded": 0,
             "shed_deadline": 0, "waves": 0, "host_waves": 0,
             "device_waves": 0, "probe_waves": 0, "crash_fallbacks": 0,
+            # Device-routed waves whose dominant keyset was resident at
+            # route time, and chunk dispatches actually served from
+            # residency (devcache.py) — operators watching a consensus
+            # stream should see hot_waves track device_waves once the
+            # validator keyset recurs.
+            "devcache_hot_waves": 0, "devcache_dispatch_hits": 0,
         }
         self._thread = None
         if auto_start:
@@ -525,6 +531,11 @@ class VerifyService:
     def _note_device_outcome(self, stats: dict, probe: bool) -> None:
         """Feed one device-routed wave's verify_many stats to the
         breaker and the wave-time estimate."""
+        dc = stats.get("devcache") or {}
+        if dc.get("hit"):
+            self.totals["devcache_hot_waves"] += 1
+        self.totals["devcache_dispatch_hits"] += dc.get(
+            "dispatch_hits", 0)
         failed = bool(stats.get("device_sick")) \
             or stats.get("device_errors", 0) > 0
         participated = (

@@ -53,8 +53,14 @@ def reset_fault_counters() -> None:
 # Last-value instruments for states that are levels, not events: the
 # service's queue depth ("service_queue_sigs", "service_queue_requests"),
 # its admission state ("service_shedding": 0/1), and the breaker state
-# ("breaker_state": 0 closed / 1 half-open / 2 open).  Same process-wide
-# registry discipline as the counters.
+# ("breaker_state": 0 closed / 1 half-open / 2 open).  The device
+# operand cache (devcache.py) publishes its levels here too:
+# "devcache_hits" / "devcache_misses" / "devcache_evictions" /
+# "devcache_resident_bytes" / "devcache_resident_keysets" /
+# "devcache_restages" / "devcache_epoch" — plus the event counters
+# "devcache_restage_hash_mismatch", "devcache_stale_epoch",
+# "devcache_evict", and "devcache_drop_all" in the fault registry
+# above.  Same process-wide registry discipline as the counters.
 
 _gauge_lock = threading.Lock()
 _gauges: dict = {}
@@ -63,6 +69,15 @@ _gauges: dict = {}
 def set_gauge(name: str, value) -> None:
     with _gauge_lock:
         _gauges[name] = value
+
+
+def set_gauges(values: dict) -> None:
+    """Atomically publish a family of related gauges (one lock trip) —
+    e.g. the device operand cache's devcache_hits / devcache_misses /
+    devcache_evictions / devcache_resident_bytes levels, which soak
+    tooling reads as one consistent snapshot."""
+    with _gauge_lock:
+        _gauges.update(values)
 
 
 def gauges() -> dict:

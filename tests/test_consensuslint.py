@@ -129,6 +129,25 @@ def test_cl004_negative_new_cache_global():
     assert "_wave_cache" in findings[0].message
 
 
+def test_cl004_negative_module_global_devcache_dict():
+    """The old batch.py operand-cache shape — a module-global dict
+    keyed by digest — must be rejected in devcache.py: the subsystem's
+    whole CL004 story is that the cache is an injectable object behind
+    the allowlisted `_default` slot, never ambient module state."""
+    findings = lint_fixture(
+        "devcache.py", "_resident_cache = {}\n")
+    assert rules_of(findings) == ["CL004"]
+    assert "_resident_cache" in findings[0].message
+
+
+def test_cl004_positive_devcache_default_slot():
+    # the injectable-singleton idiom devcache.py actually uses
+    src = ("import threading\n"
+           "_default = [None]\n"
+           "_default_lock = threading.Lock()\n")
+    assert lint_fixture("devcache.py", src) == []
+
+
 def test_cl004_positive_locks_and_allowlisted():
     src = ("import threading\n"
            "_lock = threading.Lock()\n"
@@ -521,11 +540,12 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 13 knobs."""
+    these rows) and the registry knows all 16 knobs (13 + the three
+    ED25519_TPU_DEVCACHE_* knobs from the round-7 operand cache)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 13
+    assert len(rows) == len(config.KNOBS) == 16
     assert all(doc for (_, _, _, doc) in rows)
 
 

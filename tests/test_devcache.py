@@ -1,0 +1,426 @@
+"""Verdict transparency of the device operand cache (devcache.py).
+
+The consensus rule under test: RESIDENCY IS NEVER VERDICT-RELEVANT.
+For every cache path — hit, miss, stale epoch, corrupt resident entry,
+evict storm — forced-device verdicts must be bit-identical to the pure
+host oracle, on the consensus-critical small-order conformance-matrix
+inputs as well as ordinary batches, single-device and on the virtual
+8-device mesh.  Every degraded path falls back to a full cold restage
+(hash-pinned to the bytes the host would have staged); nothing the
+cache does can reach a verdict except by shipping provably identical
+bytes.
+
+Also pinned here: the cache unit semantics (content addressing,
+second-sight build policy, deterministic LRU under the byte budget),
+the `Verifier.invalidate()` epoch wire, lane-death residency drops, and
+the published gauges."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import (
+    Signature,
+    SigningKey,
+    batch,
+    devcache,
+    faults,
+    health,
+)
+from ed25519_consensus_tpu.ops import msm
+from ed25519_consensus_tpu.utils import metrics
+
+jax = pytest.importorskip("jax")
+
+rng = random.Random(0xDE7CAC)
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    """Every test gets a fresh injected cache; nothing leaks out.
+
+    The raised EMA prior is the fault-suite idiom (test_faults.py):
+    on a loaded CPU backend a real-clock dispatch can miss the 2 s
+    deadline floor, arming a device cooldown that silently turns every
+    later rep pure-host — and a pure-host rep never touches the cache,
+    voiding the lookup-seam assertions."""
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 26,
+                                        enabled=True)
+    devcache.set_default_cache(cache)
+    yield cache
+    faults.uninstall()
+    devcache.set_default_cache(None)
+    # Lane workers stay alive across tests (the test_faults idiom):
+    # per-test _DeviceLane.reset_all() pays a multi-second join per
+    # teardown and re-warms nothing of value — health state is what
+    # must not leak, and that resets here.
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+
+
+def _require_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"need {n} devices, have {len(jax.devices())}")
+
+
+# -- workload builders -----------------------------------------------------
+
+def _small_order_encodings():
+    from ed25519_consensus_tpu.ops import edwards
+    from ed25519_consensus_tpu.utils import fixtures
+
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    return encs
+
+
+def matrix_verifier(subset_stride: int = 3):
+    """A verifier queueing a small-order conformance-matrix SUBSET
+    (every (A, R) pair with A-index·stride alignment, s = 0 — all valid
+    under ZIP215): 14 distinct torsion/non-canonical keys, the exact
+    key material the consensus matrix pins.  The same call always
+    builds the same keyset blob, so repeated calls recur in the
+    cache."""
+    encs = _small_order_encodings()
+    s_bytes = b"\x00" * 32
+    v = batch.Verifier()
+    n = 0
+    for i, A_bytes in enumerate(encs):
+        for j, R_bytes in enumerate(encs):
+            if (i * len(encs) + j) % subset_stride == 0:
+                v.queue((A_bytes, Signature(R_bytes, s_bytes), b"Zcash"))
+                n += 1
+    assert n >= 196 // (subset_stride + 1)  # a real matrix subset
+    return v
+
+
+_KEYS = [SigningKey.new(rng) for _ in range(6)]
+
+
+def recurring_verifier(tag: bytes, bad: bool = False):
+    """One batch over the FIXED 6-key validator set (fresh messages per
+    call — the consensus workload shape: recurring keyset, new
+    payloads).  `bad` tampers one signature, so the stream carries
+    False verdicts through the cache too."""
+    v = batch.Verifier()
+    for i, sk in enumerate(_KEYS):
+        msg = b"devcache-%s-%d" % (tag, i)
+        sig = sk.sign(msg if not (bad and i == 0) else b"tampered")
+        v.queue((sk.verification_key_bytes(), sig, msg))
+    return v
+
+
+def host_verdicts(vs):
+    return [batch._host_verdict(v, rng) for v in vs]
+
+
+def run_forced_device(vs, mesh=0):
+    """Forced-device verify_many (no racing host lane beyond the
+    scheduler's own grace machinery), chunk=2 as in the fault suite."""
+    return batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                             merge="never", mesh=mesh)
+
+
+# -- unit semantics --------------------------------------------------------
+
+def test_content_addressing_and_second_sight_build(reset_state):
+    cache = reset_state
+    d1 = devcache.keyset_digest(b"\x01" * 32)
+    d2 = devcache.keyset_digest(b"\x02" * 32)
+    assert d1 != d2 and len(d1) == 32
+    # first sight: remember, don't build; second sight: build
+    assert not cache.should_build(d1)
+    assert cache.should_build(d1)
+    assert not cache.should_build(d2)
+    head = np.arange(4 * 20 * 4, dtype=np.int16).reshape(4, 20, 4)
+    entry = cache.build(d1, 1, head)
+    assert entry is not None and entry.n_head == 4
+    assert cache.lookup(d1) is entry
+    assert cache.lookup(d2) is None
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1 and st["builds"] == 1
+
+
+def test_deterministic_lru_eviction_under_budget(reset_state):
+    head = np.zeros((4, 20, 4), dtype=np.int16)  # 1280 B each
+    cache = devcache.DeviceOperandCache(budget_bytes=3 * head.nbytes,
+                                        enabled=True)
+    digests = [devcache.keyset_digest(bytes([i]) * 32) for i in range(4)]
+    for d in digests[:3]:
+        cache.build(d, 1, head)
+    assert cache.resident_count() == 3
+    cache.lookup(digests[0])  # 0 is now most recently used
+    cache.build(digests[3], 1, head)  # over budget: evict LRU = 1
+    assert cache.lookup(digests[1]) is None  # evicted
+    assert cache.lookup(digests[0]) is not None
+    assert cache.counters["evictions"] == 1
+    # an entry larger than the whole budget is never resident
+    big = np.zeros((4, 20, 400), dtype=np.int16)
+    assert cache.build(digests[1], 1, big) is None
+
+
+def test_entry_too_large_and_disabled_paths(reset_state):
+    off = devcache.DeviceOperandCache(budget_bytes=0, enabled=True)
+    assert not off.enabled
+    d = devcache.keyset_digest(b"k" * 32)
+    assert off.lookup(d) is None and not off.should_build(d)
+    assert off.build(d, 1, np.zeros((4, 20, 4), np.int16)) is None
+
+
+def test_gauges_published(reset_state):
+    cache = reset_state
+    d = devcache.keyset_digest(b"g" * 32)
+    cache.should_build(d)
+    cache.build(d, 1, np.zeros((4, 20, 4), np.int16))
+    cache.lookup(d)
+    g = metrics.gauges()
+    assert g["devcache_resident_keysets"] == 1
+    assert g["devcache_resident_bytes"] == cache.resident_bytes()
+    assert g["devcache_hits"] >= 1
+
+
+# -- verdict transparency: hit and miss paths ------------------------------
+
+def test_cold_miss_path_bit_identical_to_cache_off(reset_state):
+    """The cold-miss path must be bit-identical to today's (cache-off)
+    behavior: same verdicts, same staged dispatch — pinned by running
+    the same workload under a disabled cache and a cold enabled one."""
+    vs_off = [recurring_verifier(b"cold", bad=True),
+              recurring_verifier(b"cold2")]
+    hv = host_verdicts([recurring_verifier(b"cold", bad=True),
+                        recurring_verifier(b"cold2")])
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(enabled=False))
+    off = run_forced_device(vs_off)
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(budget_bytes=1 << 26, enabled=True))
+    on = run_forced_device([recurring_verifier(b"cold", bad=True),
+                            recurring_verifier(b"cold2")])
+    assert off == on == hv == [False, True]
+
+
+def test_recurring_keyset_hits_and_verdicts_identical(reset_state):
+    """The consensus stream shape: the same keyset batch after batch.
+    Sight 1 stages cold, sight 2 builds residency, sight 3+ dispatch
+    from it — and every rep's forced-device verdicts equal the host
+    oracle bit-for-bit, False verdicts included."""
+    cache = reset_state
+    saw_dispatch_hit = False
+    for rep in range(5):
+        bad = rep in (1, 4)
+        vs = [recurring_verifier(b"rep%d" % rep, bad=bad),
+              recurring_verifier(b"rep%d-b" % rep)]
+        hv = host_verdicts([recurring_verifier(b"rep%d" % rep, bad=bad),
+                            recurring_verifier(b"rep%d-b" % rep)])
+        verdicts = run_forced_device(vs)
+        assert verdicts == hv == [not bad, True]
+        dc = batch.last_run_stats["devcache"]
+        if rep >= 2:
+            assert dc["hit"], f"rep {rep}: keyset should be resident"
+        saw_dispatch_hit |= dc["dispatch_hits"] > 0
+    assert saw_dispatch_hit
+    assert cache.counters["hits"] >= 2
+    assert cache.resident_count() == 1  # ONE recurring keyset
+
+
+def test_small_order_matrix_through_cached_device_path(reset_state):
+    """The conformance-matrix subset through the forced-device lane
+    three times: cold, build, hit — all three verdict vectors identical
+    to the host oracle (all-valid under ZIP215), with the hot rep
+    actually dispatching from residency."""
+    cache = reset_state
+    hv = host_verdicts([matrix_verifier()])
+    assert hv == [True]
+    for rep in range(3):
+        assert run_forced_device([matrix_verifier()]) == hv
+    assert cache.counters["hits"] >= 1
+    assert batch.last_run_stats["devcache"]["hit"]
+
+
+# -- verdict transparency: fault paths -------------------------------------
+
+def _faulted_recurring_run(kind, reset_state, reps=4,
+                           fault_window=(2, 4)):
+    """Drive the recurring-keyset stream with a devcache fault plan
+    active in the middle reps; assert every rep's verdicts equal the
+    host oracle and return the cache for counter assertions."""
+    cache = reset_state
+    # Warm residency first (two sights), then fault the lookups.
+    for rep in range(2):
+        vs = [recurring_verifier(b"w%d" % rep)]
+        assert run_forced_device(vs) == [True]
+    plan = faults.devcache_plan(
+        seed=0xD3, kind=kind, at=fault_window[0] - 2,
+        length=fault_window[1] - fault_window[0])
+    with faults.injected(plan):
+        for rep in range(reps):
+            bad = rep == 1
+            vs = [recurring_verifier(b"f%d" % rep, bad=bad)]
+            hv = host_verdicts(
+                [recurring_verifier(b"f%d" % rep, bad=bad)])
+            assert run_forced_device(vs) == hv == [not bad]
+    assert plan.calls_seen(faults.SITE_DEVCACHE) >= 1
+    return cache
+
+
+def test_corrupt_resident_entry_forces_restage_never_a_verdict(
+        reset_state):
+    """Injected host-mirror corruption at the lookup seam: the per-hit
+    hash re-check catches it, the entry drops, the batch restages cold
+    — verdicts identical to the host oracle throughout."""
+    base = metrics.fault_counters().get(
+        "devcache_restage_hash_mismatch", 0)
+    cache = _faulted_recurring_run("corrupt", reset_state)
+    assert cache.counters["restage_hash_mismatch"] >= 1
+    assert metrics.fault_counters()[
+        "devcache_restage_hash_mismatch"] > base
+
+
+def test_evict_storm_degrades_to_cold_staging(reset_state):
+    """An eviction storm at the moment of use: residency vanishes, the
+    lookups become misses, every batch stages cold — verdicts
+    unchanged."""
+    cache = _faulted_recurring_run("evict", reset_state)
+    assert cache.counters["drops"] >= 1
+
+
+def test_stale_epoch_hit_restages(reset_state):
+    """An epoch bump landing between staging and dispatch: the stale
+    entry is dropped, the chunk restages, the NEXT sight rebuilds under
+    the new epoch — verdicts unchanged."""
+    cache = _faulted_recurring_run("stale", reset_state)
+    assert cache.counters["stale_epoch"] >= 1
+    assert cache.epoch >= 1
+
+
+# -- invalidation semantics ------------------------------------------------
+
+def test_verifier_invalidate_bumps_cache_epoch(reset_state):
+    cache = reset_state
+    e0 = cache.epoch
+    v = recurring_verifier(b"inv")
+    v.invalidate("operator said so")
+    assert cache.epoch == e0 + 1
+    assert v.invalid_reason == "operator said so"
+
+
+def test_invalidate_mid_stream_restages_and_verdicts_hold(reset_state):
+    """Residency built, then an out-of-band `Verifier.invalidate()` on
+    an UNRELATED verifier bumps the epoch: the next dispatch of the
+    still-valid recurring keyset must treat its entry as stale, restage
+    from scratch, and produce host-identical verdicts on the
+    conformance-matrix subset through the forced-device path."""
+    cache = reset_state
+    hv = host_verdicts([matrix_verifier()])
+    for rep in range(3):  # cold, build, hit
+        assert run_forced_device([matrix_verifier()]) == hv
+    assert cache.counters["hits"] >= 1
+    doomed = recurring_verifier(b"doomed")
+    doomed.invalidate("poison sighted")
+    # The resident matrix keyset is now stale; the next run restages
+    # (stale_epoch ticks) and STILL matches the oracle.
+    assert run_forced_device([matrix_verifier()]) == hv
+    assert cache.counters["stale_epoch"] >= 1
+    # ...and the keyset becomes resident again under the new epoch.
+    assert run_forced_device([matrix_verifier()]) == hv
+    st = cache.stats()
+    assert st["resident_keysets"] == 1 and st["epoch"] >= 1
+
+
+# -- lane death drops residency --------------------------------------------
+
+def test_lane_death_drops_all_residency(reset_state):
+    """`mark_lane_stuck` (the canonical lane-death/abandonment
+    transition) must drop every resident entry: the replacement lane
+    restages from scratch."""
+    cache = reset_state
+    d = devcache.keyset_digest(b"r" * 32)
+    cache.should_build(d)
+    cache.build(d, 1, np.zeros((4, 20, 4), np.int16))
+    assert cache.resident_count() == 1
+    h = health.DeviceHealth(clock=health.FakeClock())
+    h.mark_lane_stuck()
+    assert cache.resident_count() == 0
+    assert cache.counters["drops"] == 1
+
+
+# -- the mesh lane ---------------------------------------------------------
+
+def test_mesh_cached_dispatch_verdicts_identical(reset_state):
+    """Per-shard residency under the 8-virtual-device mesh: recurring
+    keyset, forced-device mesh dispatch, verdicts equal the host oracle
+    on every rep, with the hot reps serving from residency."""
+    _require_devices(8)
+    cache = reset_state
+    saw_hit = False
+    for rep in range(4):
+        bad = rep == 2
+        vs = [recurring_verifier(b"m%d" % rep, bad=bad),
+              recurring_verifier(b"m%d-b" % rep)]
+        hv = host_verdicts([recurring_verifier(b"m%d" % rep, bad=bad),
+                            recurring_verifier(b"m%d-b" % rep)])
+        assert run_forced_device(vs, mesh=8) == hv == [not bad, True]
+        saw_hit |= batch.last_run_stats["devcache"]["dispatch_hits"] > 0
+    assert saw_hit
+    assert cache.counters["hits"] >= 1
+
+
+def test_mesh_small_order_matrix_cached(reset_state):
+    """The conformance-matrix subset through the CACHED mesh lane: the
+    sharded always-split head layout (head digits on shard 0 only,
+    replicated resident head) must agree with the host oracle."""
+    _require_devices(8)
+    cache = reset_state
+    hv = host_verdicts([matrix_verifier(subset_stride=4)])
+    for rep in range(3):
+        got = run_forced_device([matrix_verifier(subset_stride=4)],
+                                mesh=8)
+        assert got == hv == [True]
+    assert cache.counters["hits"] >= 1
+
+
+# -- staging-layer equivalence ---------------------------------------------
+
+def test_cached_operand_layout_matches_head_tensor(reset_state):
+    """`StagedBatch.device_operands_cached` + the resident head tensor
+    must describe exactly the MSM that `device_operands` (cold path)
+    describes: same per-lane scalar digits on the shared head columns,
+    R wire equal to the cold compressed wire's R columns."""
+    from ed25519_consensus_tpu.ops import limbs
+
+    v = recurring_verifier(b"layout")
+    staged = v._stage(rng)
+    head = staged.head_tensor()
+    n_coeff = len(staged.coeffs)
+    assert head.shape == (4, limbs.NLIMBS, 2 * n_coeff)
+    assert head.dtype == np.int16
+    # hash pinning is over these exact bytes
+    entry = devcache.ResidentKeyset(
+        devcache.keyset_digest(staged.keyset_blob), n_coeff - 1,
+        head, epoch=0)
+    assert entry.recheck()
+    entry.head_tensor[0, 0, 0] ^= 1
+    assert not entry.recheck()
+    # digits: always-split layout covers every coefficient
+    digits, rwire = staged.device_operands_cached(lambda n: n)
+    n = staged.n_cached_terms
+    assert digits.shape[-1] == n
+    assert rwire.shape == (33, n - 2 * n_coeff)
+    # the R columns of the cold compressed wire equal the cached R wire
+    cold_digits, cold_wire = staged.device_operands(
+        lambda m: m, wire="compressed")
+    assert np.array_equal(cold_wire[:, -staged.n_sigs:],
+                          rwire[:, :staged.n_sigs])
+
+
+def test_keyset_blob_is_canonical_group_order(reset_state):
+    """The content address is the canonical keyset blob: key encodings
+    in group-id (first-seen) order — the same order staging uses, and
+    the same blob `_canonical_keyset_blob` reports without staging."""
+    v = recurring_verifier(b"canon")
+    blob = v._canonical_keyset_blob()
+    staged = v._stage(rng)
+    assert staged.keyset_blob == blob
+    assert len(blob) == 32 * len(_KEYS)

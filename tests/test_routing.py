@@ -121,9 +121,11 @@ def test_verify_many_auto_selects_mesh_above_crossover():
     # returns the full device count — shrink it to 2 devices by calling
     # through a policy wrapper to keep the virtual-mesh compile small.
     class TwoDevicePolicy(routing.RoutingPolicy):
-        def choose_mesh(self, est, n_devices=None, health=None):
+        def choose_mesh(self, est, n_devices=None, health=None,
+                        devcache_hot=False):
             return super().choose_mesh(est, n_devices=mesh_d,
-                                       health=health)
+                                       health=health,
+                                       devcache_hot=devcache_hot)
 
     pol2 = TwoDevicePolicy(fixed_cost_s=1e-9, per_term_s=1.0,
                            min_devices=2)
@@ -191,3 +193,66 @@ def test_auto_resolution_happens_on_merged_unions(fast_device):
     # default policy, tiny unions: single-device lane
     assert batch.last_run_stats["mesh"] == 0
     assert batch.last_run_stats["merged_unions"] == 1
+
+
+# -- cache temperature as a routing input (devcache.py, round 7) -----------
+
+
+def test_cold_cache_never_changes_crossover():
+    """REGRESSION: with a cold cache (devcache_hot=False — the default,
+    and what a cold/disabled cache probes to), the crossover and every
+    choose_mesh decision are bit-identical to the r5 model — the cache
+    can only ever LOWER the crossover, and only when hot."""
+    pol = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6,
+                                hot_scale=0.75)
+    base = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6,
+                                 hot_scale=1.0)
+    h = health.DeviceHealth(mesh=8, clock=health.FakeClock())
+    for d in (1, 2, 4, 8):
+        assert (pol.crossover_terms(d)
+                == pol.crossover_terms(d, devcache_hot=False)
+                == base.crossover_terms(d, devcache_hot=True))
+    for est in (100, 20_000, 26_000, 27_000, 30_000, 10**6):
+        assert (pol.choose_mesh(est, n_devices=8, health=h)
+                == pol.choose_mesh(est, n_devices=8, health=h,
+                                   devcache_hot=False))
+
+
+def test_hot_keyset_lowers_crossover():
+    """A resident keyset scales the fixed cost a by hot_scale: N* drops
+    proportionally, so batches between the hot and cold crossovers
+    shard only when hot."""
+    pol = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6,
+                                hot_scale=0.75)
+    h = health.DeviceHealth(mesh=8, clock=health.FakeClock())
+    cold = pol.crossover_terms(8)
+    hot = pol.crossover_terms(8, devcache_hot=True)
+    assert hot == pytest.approx(0.75 * cold)
+    between = int((hot + cold) / 2)
+    assert pol.choose_mesh(between, n_devices=8, health=h) == 0
+    assert pol.choose_mesh(between, n_devices=8, health=h,
+                           devcache_hot=True) == 8
+    # hot_scale=1.0 disables the effect entirely
+    flat = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6,
+                                 hot_scale=1.0)
+    assert flat.crossover_terms(8, devcache_hot=True) == \
+        flat.crossover_terms(8)
+
+
+def test_stats_report_devcache_probe(fast_device):
+    """last_run_stats carries the cache-temperature input the routing
+    decision consumed: {"hit": bool, "resident_bytes": int} plus the
+    dispatch-hit count — auditable per call."""
+    from ed25519_consensus_tpu import devcache
+
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(budget_bytes=1 << 26, enabled=True))
+    try:
+        vs = make_verifiers(3)
+        batch.verify_many(vs, rng=rng, chunk=2, merge="never")
+        dc = batch.last_run_stats["devcache"]
+        assert set(dc) == {"hit", "resident_bytes", "dispatch_hits"}
+        assert dc["hit"] is False  # cold cache
+        assert dc["resident_bytes"] == 0
+    finally:
+        devcache.set_default_cache(None)

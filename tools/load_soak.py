@@ -20,6 +20,19 @@ Storm profiles (--storm; faults.storm_plan + request-side schedules):
 * ``deadline`` — a deadline storm on the REQUEST side: a third of the
   submissions carry tight or already-expired deadlines.
 * ``mixed``    — randomized_plan faults + the deadline storm together.
+* ``churn``    — a CACHE-CHURN storm against the device operand cache
+  (devcache.py): every round's batches recur over one of K alternating
+  validator keysets while the injected cache's byte budget holds only
+  two resident entries, so the rotation drives build → hit → evict →
+  rebuild continuously; a rotating devcache fault plan
+  (corrupt-resident-entry / evict-storm / stale-epoch) rides on the
+  lookup seam in every round.  Extra gate on top of the universal two:
+  the run must actually exercise residency (devcache_hits gauge > 0 —
+  published in the summary's `gauges`) or the soak fails.  Provision
+  enough rounds for the rotation to revisit a keyset (≥ 4; bigger
+  --sigs means fewer chunks/lookups per round, so scale rounds up with
+  it) — an under-provisioned churn run fails its gates honestly rather
+  than printing a false green.
 
 Usage:
   python tools/load_soak.py [--seed 0x10AD] [--rounds 4] [--submitters 3]
@@ -43,22 +56,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from ed25519_consensus_tpu import (  # noqa: E402
-    SigningKey, batch, faults, service,
+    SigningKey, batch, devcache, faults, service,
 )
 from ed25519_consensus_tpu.utils import metrics  # noqa: E402
 
 from chaos_soak import warm_shapes  # noqa: E402  (same tools/ dir)
 
 
-def make_pool(rnd, keys, n_batches, sigs):
+def make_pool(rnd, keys, n_batches, sigs, keyset=None):
     """Mixed valid/tampered batches (fixed size — one warmed chunk shape,
-    see chaos_soak.make_pool)."""
+    see chaos_soak.make_pool).  With `keyset` (the churn storm), sig j of
+    EVERY batch signs with keyset[j]: all batches share one canonical
+    keyset blob, so chunks are keyset-uniform and recur in devcache."""
     vs, want = [], []
     for b in range(n_batches):
         v = batch.Verifier()
         bad_at = rnd.randrange(sigs) if rnd.random() < 0.35 else -1
         for j in range(sigs):
-            sk = rnd.choice(keys)
+            sk = keyset[j % len(keyset)] if keyset else rnd.choice(keys)
             m = b"load %d %d" % (b, j)
             sig = sk.sign(m)
             if j == bad_at:
@@ -72,6 +87,11 @@ def make_pool(rnd, keys, n_batches, sigs):
 def storm_for(profile, seed, site):
     if profile in ("none", "deadline"):
         return None
+    if profile == "churn":
+        # A devcache fault window rides every churn round, rotating the
+        # kind by seed so the soak sweeps all three seams over time.
+        kind = ("corrupt", "evict", "stale")[seed % 3]
+        return faults.devcache_plan(seed, kind, at=2, length=4)
     if profile == "stall":
         # default storm seconds: above the warmed 8-batch chunk budget,
         # so the window deterministically blows deadlines
@@ -102,10 +122,32 @@ def deadline_for(profile, rnd):
     return None if rnd.random() < 0.5 else 120.0
 
 
+def churn_keysets(keys, sigs):
+    """THREE disjoint validator keysets for the churn storm, sigs keys
+    each (same head-tensor shape/size every batch).  Three keysets over
+    a two-entry budget is the minimal always-churning rotation: every
+    round's keyset either hits residency or evicts the LRU entry to
+    rebuild — the cache can never reach a steady state that stops
+    exercising build/evict.  The shared pool is extended with fresh
+    deterministic keys when 3·sigs exceeds it, so any --sigs yields
+    exactly three disjoint sets."""
+    keys = list(keys)
+    grow = random.Random(0xC0AB)
+    while len(keys) < 3 * sigs:
+        keys.append(SigningKey.new(grow))
+    return [keys[i * sigs:(i + 1) * sigs] for i in range(3)]
+
+
 def run_round(r, round_seed, args, keys, site):
     rnd = random.Random(round_seed ^ 0x5EED)
+    # Churn storm: the whole round recurs over ONE keyset, rotating per
+    # round — with the injected two-entry budget, the rotation is a
+    # continuous build → hit → evict → rebuild cycle.
+    keyset = (churn_keysets(keys, args.sigs)[r % 3]
+              if args.storm == "churn" else None)
     vs, want = make_pool(rnd, keys,
-                         args.submitters * args.requests, args.sigs)
+                         args.submitters * args.requests, args.sigs,
+                         keyset=keyset)
     host_truth = [batch._host_verdict(v.clone(), random.Random(
         round_seed ^ 0xB11D)) for v in vs]
     assert host_truth == want, "host ground truth must match construction"
@@ -210,7 +252,7 @@ def main(argv=None):
     ap.add_argument("--mesh", type=int, default=0)
     ap.add_argument("--storm", default="mixed",
                     choices=["none", "stall", "death", "error",
-                             "deadline", "mixed"])
+                             "deadline", "mixed", "churn"])
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--max-waivers", type=int, default=5,
                     help="consensuslint waiver ratchet: fail the soak if "
@@ -245,6 +287,21 @@ def main(argv=None):
     keys = [SigningKey.new(rnd) for _ in range(16)]
     site = faults.SITE_SHARDED if args.mesh and args.mesh > 1 \
         else faults.SITE_LANE
+    cache = None
+    if args.storm == "churn":
+        # Inject a cache whose budget holds exactly TWO resident head
+        # tensors: the per-round keyset rotation then cycles residency
+        # through build → hit → evict → rebuild for the whole soak.
+        # The raised EMA prior is the fault-suite idiom: on a loaded CI
+        # backend a real-clock dispatch can miss the 2 s deadline
+        # floor, arming a cooldown that would starve the lookup stream
+        # the churn gate asserts on.
+        os.environ.setdefault("ED25519_TPU_EMA_PRIOR", "10")
+        from ed25519_consensus_tpu.ops import limbs
+        entry_bytes = 4 * limbs.NLIMBS * 2 * (args.sigs + 1) * 2
+        cache = devcache.DeviceOperandCache(
+            budget_bytes=int(2.5 * entry_bytes), enabled=True)
+        devcache.set_default_cache(cache)
     warm_vs, _ = make_pool(random.Random(args.seed ^ 0xA), keys,
                            1, args.sigs)
     warm_shapes(warm_vs[0], chunk=8, mesh=args.mesh)
@@ -271,7 +328,18 @@ def main(argv=None):
                   f"breaker={rec['breaker']:9s} "
                   f"{'OK' if ok else 'VIOLATION'}")
     dt = time.time() - t_begin
-    if args.storm in ("stall", "death", "error", "mixed") \
+    if args.storm == "churn":
+        # The churn-specific gate: residency must actually have been
+        # exercised — a soak whose lookups never hit tested nothing of
+        # the cache, and the hit-rate gauge must be published.
+        st = cache.stats()
+        if st["hits"] == 0 or \
+                metrics.gauges().get("devcache_hits", 0) == 0:
+            print(f"VIOLATION: churn storm produced no devcache hits "
+                  f"(stats={st}) — residency never exercised",
+                  file=sys.stderr)
+            violations += 1
+    if args.storm in ("stall", "death", "error", "mixed", "churn") \
             and totals["injected"] == 0:
         # A device-fault storm that never injected tested nothing — a
         # soak must not print a false green on the acceptance bar.
@@ -285,6 +353,8 @@ def main(argv=None):
         "fault_counters": metrics.fault_counters(),
         "gauges": metrics.gauges(), **totals,
     }
+    if cache is not None:
+        summary["devcache"] = cache.stats()
     print("LOAD_SOAK", json.dumps(summary))
     sys.stdout.flush()  # os._exit skips buffer flushing (piped CI logs)
     # exit like bench.py/chaos_soak.py: never risk native teardown with a
