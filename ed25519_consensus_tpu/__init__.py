@@ -9,7 +9,7 @@ device meshes for large batches.
 
 Public surface mirrors reference src/lib.rs:6-16."""
 
-from . import batch, faults, health, routing, serde, service
+from . import batch, devcache, faults, health, routing, serde, service, tenancy
 from .error import (
     Error,
     InvalidSignature,
@@ -41,9 +41,11 @@ __all__ = [
     "VerificationKey",
     "VerificationKeyBytes",
     "batch",
+    "devcache",
     "faults",
     "health",
     "routing",
     "serde",
     "service",
+    "tenancy",
 ]

@@ -167,6 +167,24 @@ KNOBS: "dict[str, Knob]" = dict([
        "Factor applied to the N* crossover model's fixed cost `a` "
        "when the dispatched keyset is device-resident (a hot keyset "
        "lowers the effective crossover); 1.0 disables the effect."),
+    _k("ED25519_TPU_DEVCACHE_TENANT_QUOTA", "int", 0,
+       "Per-tenant device-operand-cache residency quota in bytes "
+       "(cache QoS): >0 partitions the byte budget so one tenant's "
+       "keyset churn can never evict another tenant's entries; 0 "
+       "keeps the single shared LRU pool."),
+    _k("ED25519_TPU_CLASS_WATERMARK_MEMPOOL", "float", 0.85,
+       "Queue-depth fraction of service capacity at which NEW "
+       "mempool-class submissions shed (the VerifyService "
+       "high-watermark default; consensus-class never watermark-"
+       "sheds)."),
+    _k("ED25519_TPU_CLASS_WATERMARK_RPC", "float", 0.50,
+       "Queue-depth fraction of service capacity at which NEW "
+       "rpc-class submissions shed; must not exceed the mempool "
+       "watermark (rpc sheds first under overload)."),
+    _k("ED25519_TPU_TRAFFIC_LAB_SEED", "int", 0x7AFF1C,
+       "Default seed for tools/traffic_lab.py's open-loop arrival "
+       "processes and workload construction (the run is a pure "
+       "function of it)."),
 ])
 
 

@@ -38,6 +38,19 @@ exactly this recurrence with the keyset-blob cache
   the same size per dispatch mode).  Eviction is strict
   least-recently-USED in lookup order — deterministic, so soak replays
   see identical hit/miss streams.
+* **Tenancy (cache QoS).**  Every entry belongs to a tenant partition
+  (`assign_tenant` maps keyset digests to tenants; unassigned digests
+  share the DEFAULT_TENANT pool).  With
+  `ED25519_TPU_DEVCACHE_TENANT_QUOTA` > 0, eviction NEVER crosses a
+  partition boundary: a tenant churning through rotating keysets
+  evicts only its own entries (or fails to become resident at all),
+  so another tenant's hot keyset residency — and hit rate — is
+  untouched by design.  `rotate_tenant()` models validator-set
+  rotation at an epoch boundary: it stales exactly that tenant's
+  entries (per-entry `tenant_epoch` pinning, checked on every hit
+  alongside the global epoch), which then degrade to cold staging and
+  rebuild under the new epoch — the same verdict-transparent rung as
+  every other degradation here.
 * **Epochs.**  `bump_epoch()` invalidates every entry logically
   without touching them (entries carry their build epoch; a
   stale-epoch lookup drops the entry and restages).  It is wired to
@@ -67,6 +80,7 @@ import threading
 from . import config as _config
 from . import faults as _faults
 from . import health as _health
+from . import tenancy as _tenancy
 from .utils import metrics as _metrics
 
 __all__ = [
@@ -87,15 +101,24 @@ class ResidentKeyset:
     per-dispatch-mode device array handles."""
 
     __slots__ = ("digest", "n_keys", "head_tensor", "head_hash",
-                 "epoch", "nbytes", "_device_refs", "_seq")
+                 "epoch", "tenant", "tenant_epoch", "nbytes",
+                 "_device_refs", "_seq")
 
     def __init__(self, digest: bytes, n_keys: int, head_tensor,
-                 epoch: int):
+                 epoch: int, tenant: str = _tenancy.DEFAULT_TENANT,
+                 tenant_epoch: int = 0):
         self.digest = digest
         self.n_keys = int(n_keys)
         self.head_tensor = head_tensor  # (4, NLIMBS, 2*(n_keys+1)) int16
         self.head_hash = hashlib.sha256(head_tensor.tobytes()).digest()
         self.epoch = int(epoch)
+        # Tenancy (cache QoS): the partition this entry's bytes count
+        # against, and the tenant's rotation epoch at build time — a
+        # per-tenant rotation (validator-set change at an epoch
+        # boundary) stales exactly this tenant's entries, nobody
+        # else's.
+        self.tenant = tenant
+        self.tenant_epoch = int(tenant_epoch)
         self.nbytes = int(head_tensor.nbytes)
         self._device_refs = {}  # mesh key -> committed device array
         self._seq = 0  # last-used lookup sequence (cache-maintained)
@@ -141,12 +164,24 @@ class DeviceOperandCache:
     third on."""
 
     def __init__(self, budget_bytes: "int | None" = None,
-                 enabled: "bool | None" = None):
+                 enabled: "bool | None" = None,
+                 tenant_quota_bytes: "int | None" = None):
         if enabled is None:
             enabled = _config.get("ED25519_TPU_DEVCACHE")
         if budget_bytes is None:
             budget_bytes = _config.get("ED25519_TPU_DEVCACHE_BYTES")
+        if tenant_quota_bytes is None:
+            tenant_quota_bytes = _config.get(
+                "ED25519_TPU_DEVCACHE_TENANT_QUOTA")
         self.budget_bytes = int(budget_bytes)
+        # Cache QoS (ROADMAP item 4): >0 partitions the byte budget
+        # into per-tenant residency quotas — eviction then NEVER
+        # crosses a tenant boundary, so one chain's epoch-rotation
+        # churn cannot evict another chain's hot keyset.  0 keeps the
+        # single shared LRU pool (the pre-tenancy behavior, and the
+        # behavior every digest not assigned a tenant still gets
+        # within the DEFAULT_TENANT partition).
+        self.tenant_quota_bytes = int(tenant_quota_bytes)
         self.enabled = bool(enabled) and self.budget_bytes > 0
         self._lock = threading.Lock()
         self._entries: "dict[bytes, ResidentKeyset]" = {}
@@ -154,11 +189,22 @@ class DeviceOperandCache:
         self._seen_max = 1 << 16
         self._epoch = 0
         self._lookup_seq = 0
+        # digest -> tenant assignment (service.submit(tenant=...) and
+        # the traffic lab register these; unassigned digests belong to
+        # DEFAULT_TENANT).  Bounded like _seen — an assignment is an
+        # optimization hint, never correctness state.
+        self._tenant_of: "dict[bytes, str]" = {}
+        self._tenant_epoch: "dict[str, int]" = {}
         self.counters = {
             "hits": 0, "misses": 0, "evictions": 0,
             "restage_hash_mismatch": 0, "stale_epoch": 0,
-            "builds": 0, "drops": 0,
+            "builds": 0, "drops": 0, "tenant_rotations": 0,
+            "quota_rejected": 0,
         }
+        # per-tenant hit/miss/eviction/staleness tallies (tenant ->
+        # counter dict), the fairness numbers the traffic lab and the
+        # rotation-churn gates read.
+        self._tenant_counters: "dict[str, dict]" = {}
 
     # -- epoch / residency lifecycle --------------------------------------
 
@@ -174,6 +220,94 @@ class DeviceOperandCache:
         with self._lock:
             self._epoch += 1
             return self._epoch
+
+    # -- tenancy (cache QoS + per-tenant rotation) -------------------------
+
+    def assign_tenant(self, digest: "bytes | None", tenant: str) -> None:
+        """Assign a keyset digest to a tenant partition (service.submit
+        and the traffic lab call this).  Assignment is a QoS hint for
+        FUTURE builds — an already-resident entry keeps the partition
+        it was built under until it naturally restages.  Unassigned
+        digests belong to DEFAULT_TENANT."""
+        if digest is None:
+            return
+        with self._lock:
+            if len(self._tenant_of) >= self._seen_max:
+                # Bounded-map overflow must not break the isolation
+                # guarantee: keep the assignments of every currently-
+                # RESIDENT digest (wholesale clearing would silently
+                # revert hot tenants to the shared default partition),
+                # drop only the non-resident remainder.
+                self._tenant_of = {
+                    d: t for d, t in self._tenant_of.items()
+                    if d in self._entries}
+            self._tenant_of[digest] = tenant
+
+    def tenant_of(self, digest: "bytes | None") -> str:
+        with self._lock:
+            if digest is None:
+                return _tenancy.DEFAULT_TENANT
+            return self._tenant_of.get(digest, _tenancy.DEFAULT_TENANT)
+
+    def rotate_tenant(self, tenant: str,
+                      reason: str = "epoch-rotation") -> int:
+        """Validator-set rotation at an epoch boundary for ONE tenant:
+        bump that tenant's rotation epoch, logically staling exactly
+        its entries (a lookup of a stale-tenant-epoch entry degrades to
+        cold staging and rebuilds under the new epoch).  Other tenants'
+        residency — and, as everywhere in this module, every verdict —
+        is untouched.  Returns the tenant's new epoch."""
+        with self._lock:
+            e = self._tenant_epoch.get(tenant, 0) + 1
+            self._tenant_epoch[tenant] = e
+            self.counters["tenant_rotations"] += 1
+            self._tenant_tally_locked(tenant, "rotations")
+        _metrics.record_fault("devcache_tenant_rotation")
+        self._publish()
+        return e
+
+    def tenant_epoch_of(self, tenant: str) -> int:
+        with self._lock:
+            return self._tenant_epoch.get(tenant, 0)
+
+    def _tenant_tally_locked(self, tenant: str, key: str,
+                             n: int = 1) -> None:
+        # under self._lock
+        c = self._tenant_counters.get(tenant)
+        if c is None:
+            c = {"hits": 0, "misses": 0, "evictions": 0,
+                 "stale_epoch": 0, "builds": 0, "rotations": 0,
+                 "quota_rejected": 0}
+            self._tenant_counters[tenant] = c
+        c[key] += n
+
+    def tenant_stats(self) -> "dict[str, dict]":
+        """Per-tenant residency + counter snapshot: {tenant:
+        {resident_bytes, resident_keysets, epoch, hits, misses,
+        evictions, stale_epoch, builds, rotations, quota_rejected,
+        hit_rate}} — the fairness surface the traffic lab reports and
+        the rotation-churn gates assert on."""
+        with self._lock:
+            out = {}
+            tenants = set(self._tenant_counters) | set(
+                self._tenant_epoch) | {
+                e.tenant for e in self._entries.values()}
+            for t in tenants:
+                c = dict(self._tenant_counters.get(t, ()))
+                looked = c.get("hits", 0) + c.get("misses", 0)
+                out[t] = {
+                    "resident_bytes": sum(
+                        e.nbytes for e in self._entries.values()
+                        if e.tenant == t),
+                    "resident_keysets": sum(
+                        1 for e in self._entries.values()
+                        if e.tenant == t),
+                    "epoch": self._tenant_epoch.get(t, 0),
+                    "hit_rate": (c.get("hits", 0) / looked
+                                 if looked else None),
+                    **c,
+                }
+            return out
 
     def drop_all(self, reason: str = "dropped") -> int:
         """Drop every resident entry NOW (lane death/flap, evict-storm
@@ -204,6 +338,8 @@ class DeviceOperandCache:
         with self._lock:
             e = self._entries.get(digest) if digest is not None else None
             hot = (e is not None and e.epoch == self._epoch
+                   and e.tenant_epoch == self._tenant_epoch.get(
+                       e.tenant, 0)
                    and self.enabled)
             return {"hit": bool(hot),
                     "resident_bytes": sum(
@@ -219,11 +355,25 @@ class DeviceOperandCache:
         entry = _faults.run_device_call(
             _faults.SITE_DEVCACHE, lambda: self._lookup_locked(digest),
             payload=self)
+        stale_tenant = False
+        entry_tenant = None if entry is None else entry.tenant
         if entry is not None:
             # Consensus gate — AFTER the fault seam, so an injected (or
             # real) host-mirror corruption is caught here, before any
             # dispatch could use the rotten bytes.
             if entry.epoch != self._current_epoch():
+                stale_tenant = True  # global staleness tallies too
+                self._drop(digest, "stale_epoch")
+                _metrics.record_fault("devcache_stale_epoch")
+                entry = None
+            elif entry.tenant_epoch != self.tenant_epoch_of(entry.tenant):
+                # The entry's TENANT rotated since build (validator-set
+                # change at an epoch boundary, possibly landing mid-
+                # wave via the rotation fault seam): stale exactly like
+                # a global epoch bump — degrade to cold staging and
+                # rebuild under the new tenant epoch.  Other tenants'
+                # entries never enter this branch.
+                stale_tenant = True
                 self._drop(digest, "stale_epoch")
                 _metrics.record_fault("devcache_stale_epoch")
                 entry = None
@@ -233,6 +383,19 @@ class DeviceOperandCache:
                 entry = None
         with self._lock:
             self.counters["hits" if entry is not None else "misses"] += 1
+            # Attribution: an entry that WAS found (hit, or dropped as
+            # stale) tallies against its BUILD partition — the one its
+            # bytes counted toward — while a true miss can only go by
+            # the current assignment.  Keeps hit_rate numerators and
+            # resident_bytes denominators on the same tenant after a
+            # digest is reassigned.
+            t = (entry_tenant if entry_tenant is not None
+                 else self._tenant_of.get(digest,
+                                          _tenancy.DEFAULT_TENANT))
+            self._tenant_tally_locked(
+                t, "hits" if entry is not None else "misses")
+            if stale_tenant:
+                self._tenant_tally_locked(t, "stale_epoch")
         self._publish()
         return entry
 
@@ -272,33 +435,116 @@ class DeviceOperandCache:
         (`StagedBatch.head_tensor()`), evicting least-recently-used
         entries past the byte budget.  Returns the entry, or None when
         the tensor alone exceeds the whole budget (a keyset too large
-        to ever be resident — cold staging is the steady state then)."""
+        to ever be resident — cold staging is the steady state then).
+
+        With per-tenant quotas armed (`tenant_quota_bytes > 0`)
+        eviction is PARTITIONED: only entries of the building digest's
+        own tenant are eviction candidates — for its quota AND for the
+        global budget — so another tenant's hot keyset can never be the
+        victim of this tenant's churn.  If the global budget is held
+        entirely by OTHER tenants' bytes (quotas oversubscribe the
+        budget — an operator misconfiguration), the build is refused
+        (`quota_rejected`, cold staging stays the steady state) rather
+        than ever crossing a partition boundary."""
         if not self.enabled:
             return None
         import numpy as np
 
         head_tensor = np.ascontiguousarray(head_tensor)
-        if head_tensor.nbytes > self.budget_bytes:
+        quota = self.tenant_quota_bytes
+        if head_tensor.nbytes > self.budget_bytes or (
+                quota > 0 and head_tensor.nbytes > quota):
+            if quota > 0:
+                # QUOTA refusal is part of the fairness surface: an
+                # operator diagnosing a permanently-cold tenant must
+                # see it counted (same accounting as the
+                # oversubscription refusal below).  With quotas OFF, a
+                # tensor over the global budget is the pre-tenancy
+                # silent cold-stage condition, not a quota event.
+                with self._lock:
+                    tenant = self._tenant_of.get(digest,
+                                                 _tenancy.DEFAULT_TENANT)
+                    self.counters["quota_rejected"] += 1
+                    self._tenant_tally_locked(tenant, "quota_rejected")
+                _metrics.record_fault("devcache_quota_rejected")
+                self._publish()
             return None
         evicted = 0
+        rejected = None
         with self._lock:
-            entry = ResidentKeyset(digest, n_keys, head_tensor,
-                                   self._epoch)
-            self._lookup_seq += 1
-            entry._seq = self._lookup_seq
-            self._entries[digest] = entry
-            # Deterministic LRU: evict strictly by last-used sequence
-            # until the mirror fits the budget again.
-            while (sum(e.nbytes for e in self._entries.values())
-                   > self.budget_bytes and len(self._entries) > 1):
-                victim = min(self._entries.values(),
-                             key=lambda e: e._seq)
+            tenant = self._tenant_of.get(digest,
+                                         _tenancy.DEFAULT_TENANT)
+
+            def total(pred=lambda e: True):
+                return sum(e.nbytes for e in self._entries.values()
+                           if pred(e))
+
+            if quota > 0:
+                # Feasibility FIRST: with cross-tenant eviction off the
+                # table, the best this build can ever do is evict every
+                # other entry of its own partition — so if other
+                # tenants' bytes already crowd the new tensor out of
+                # the global budget, refuse NOW, before touching any
+                # resident entry.  A refused build must leave the
+                # tenant exactly as it found it (a failed build that
+                # destroyed the residency it could not replace would
+                # turn refusal into self-inflicted churn).
+                other = total(lambda e, t=tenant: e.tenant != t)
+                if other + head_tensor.nbytes > self.budget_bytes:
+                    self.counters["quota_rejected"] += 1
+                    self._tenant_tally_locked(tenant, "quota_rejected")
+                    rejected = True
+                    entry = None
+
+            if rejected is None:
+                entry = ResidentKeyset(
+                    digest, n_keys, head_tensor, self._epoch,
+                    tenant=tenant,
+                    tenant_epoch=self._tenant_epoch.get(tenant, 0))
+                self._lookup_seq += 1
+                entry._seq = self._lookup_seq
+                self._entries[digest] = entry
+
+            def evict_own() -> bool:
+                own = [e for e in self._entries.values()
+                       if e.tenant == tenant]
+                if len(own) <= 1:
+                    return False
+                victim = min(own, key=lambda e: e._seq)
                 del self._entries[victim.digest]
                 self.counters["evictions"] += 1
-                evicted += 1
-            self.counters["builds"] += 1
+                self._tenant_tally_locked(tenant, "evictions")
+                return True
+
+            if quota > 0 and rejected is None:
+                # Deterministic LRU WITHIN the tenant partition, first
+                # to the tenant's quota, then (still same-tenant only)
+                # to the global budget — feasible by the check above.
+                while (total(lambda e, t=tenant: e.tenant == t) > quota
+                       and evict_own()):
+                    evicted += 1
+                while total() > self.budget_bytes and evict_own():
+                    evicted += 1
+            elif quota <= 0:
+                # Unpartitioned (pre-tenancy) deterministic LRU: evict
+                # strictly by last-used sequence until the mirror fits
+                # the budget again.
+                while (total() > self.budget_bytes
+                       and len(self._entries) > 1):
+                    victim = min(self._entries.values(),
+                                 key=lambda e: e._seq)
+                    del self._entries[victim.digest]
+                    self.counters["evictions"] += 1
+                    self._tenant_tally_locked(victim.tenant,
+                                              "evictions")
+                    evicted += 1
+            if entry is not None:
+                self.counters["builds"] += 1
+                self._tenant_tally_locked(tenant, "builds")
         if evicted:
             _metrics.record_fault("devcache_evict", evicted)
+        if rejected:
+            _metrics.record_fault("devcache_quota_rejected")
         self._publish()
         return entry
 
@@ -309,10 +555,13 @@ class DeviceOperandCache:
             return {
                 "enabled": self.enabled,
                 "budget_bytes": self.budget_bytes,
+                "tenant_quota_bytes": self.tenant_quota_bytes,
                 "resident_bytes": sum(
                     e.nbytes for e in self._entries.values()),
                 "resident_keysets": len(self._entries),
                 "epoch": self._epoch,
+                "tenants": sorted(
+                    {e.tenant for e in self._entries.values()}),
                 **self.counters,
             }
 

@@ -57,6 +57,7 @@ __all__ = [
     "LaneDeathSignal",
     "Fault", "ErrorOn", "StallFor", "FlappingLink", "CorruptSum",
     "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
+    "RotateTenant",
     "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
@@ -275,6 +276,27 @@ class StaleEpochOn(Fault):
             ctx.payload.bump_epoch("stale-epoch fault")
 
 
+class RotateTenant(Fault):
+    """Rotate ONE tenant's keyset epoch at the faulted lookup
+    (ctx.payload is the cache) — validator-set rotation at an epoch
+    boundary landing exactly mid-wave, between staging and dispatch.
+    The rotated tenant's resident entries go stale (tenant-epoch
+    pinning, devcache.py) and degrade to cold staging; every OTHER
+    tenant's residency is untouched — which is precisely the isolation
+    property the rotation fault plan exists to prove.  Verdict-neutral
+    like every cache fault: a stale hit is a miss, and a miss is
+    always the cold path."""
+
+    def __init__(self, on=0, tenant: str = "default"):
+        super().__init__(on=on, site=SITE_DEVCACHE)
+        self.tenant = tenant
+
+    def before(self, ctx):
+        if ctx.payload is not None:
+            ctx.payload.rotate_tenant(self.tenant,
+                                      "rotation fault (mid-wave)")
+
+
 class _CallContext:
     __slots__ = ("plan", "site", "index", "mesh", "clock", "payload")
 
@@ -419,7 +441,8 @@ def storm_plan(seed: int, kind: str, at: int = 0, length: int = 1,
 
 
 def devcache_plan(seed: int, kind: str, at: int = 0,
-                  length: int = 1, flips: int = 4) -> FaultPlan:
+                  length: int = 1, flips: int = 4,
+                  tenant: str = "default") -> FaultPlan:
     """A fault window over the device-operand-cache LOOKUP stream
     (SITE_DEVCACHE; indices count lookups, not device calls):
 
@@ -428,7 +451,11 @@ def devcache_plan(seed: int, kind: str, at: int = 0,
     * ``"evict"``   — drop all residency at the faulted lookups (an
       eviction storm; lookups become misses);
     * ``"stale"``   — bump the cache epoch at the faulted lookups (the
-      entry about to be used goes stale and restages).
+      entry about to be used goes stale and restages);
+    * ``"rotate"``  — rotate `tenant`'s keyset epoch at the faulted
+      lookups (validator-set rotation landing mid-wave): exactly that
+      tenant's entries go stale and restage; other tenants' residency
+      must be untouched (the rotation fault plan, ROADMAP item 4).
 
     Same replay property as every other plan: decisions are pure
     functions of (seed, site, call index)."""
@@ -439,6 +466,8 @@ def devcache_plan(seed: int, kind: str, at: int = 0,
         faults = [EvictStorm(on=window)]
     elif kind == "stale":
         faults = [StaleEpochOn(on=window)]
+    elif kind == "rotate":
+        faults = [RotateTenant(on=window, tenant=tenant)]
     else:
         raise ValueError(f"unknown devcache fault kind {kind!r}")
     return FaultPlan(faults, seed=seed)

@@ -12,17 +12,26 @@ service layer on top of the same exact math.
 
 The service-layer degradation ladder (docs/failure-model.md):
 
-1. **Admit** — a bounded queue (capacity in SIGNATURES, the unit device
-   cost scales with) with admission control: a submission that would
-   exceed capacity is rejected with `Overloaded` immediately, and a
-   high/low watermark pair adds hysteresis — once depth crosses the
-   high watermark the service sheds ALL new submissions until the queue
-   drains below the low watermark, so a saturated service does useful
-   work instead of thrashing at 100% occupancy.
-2. **Coalesce** — the dispatcher drains queued requests in waves and
-   hands each wave to `verify_many`, whose union-merge machinery
-   coalesces compatible small batches into stream-path super-batches
-   (one RLC equation, recurring keys collapse across submitters).
+1. **Admit** — PER-CLASS bounded queues (capacity in SIGNATURES, the
+   unit device cost scales with) with priority-aware admission
+   control (tenancy.py): every submission names a traffic class —
+   consensus-critical / mempool / rpc — and each class sheds at its
+   own watermark over the TOTAL queue depth, lowest class first.  An
+   rpc storm starts shedding at its (low) watermark long before it
+   can crowd a prevote out; mempool keeps the historical high/low
+   hysteresis pair; consensus-class never watermark-sheds — only a
+   physically full queue can reject it, and the lower watermarks
+   exist precisely to keep that from happening.  Shedding disarms per
+   class once the queue drains below that class's resume watermark
+   (same hysteresis shape at every rung), so a saturated service does
+   useful work instead of thrashing at 100% occupancy.
+2. **Coalesce** — the dispatcher drains queued requests in waves IN
+   PRIORITY ORDER (consensus first, then mempool, then rpc; FIFO
+   within a class) and hands each wave to `verify_many`, whose
+   union-merge machinery coalesces compatible small batches into
+   stream-path super-batches (one RLC equation, recurring keys
+   collapse across submitters) — classes decide position in the wave,
+   coalescing still spans the whole wave.
 3. **Route** — per wave, the `RoutingPolicy` (routing.py) picks
    host / device / sharded-mesh from the N* crossover model plus live
    `DeviceHealth`; a manual `mesh=` override is honored unchanged.
@@ -59,6 +68,7 @@ from collections import deque
 from . import batch as _batch
 from . import health as _health
 from . import routing as _routing
+from . import tenancy as _tenancy
 from .error import Error
 from .utils import metrics as _metrics
 
@@ -229,13 +239,18 @@ class VerifyTicket:
 
 
 class _Request:
-    __slots__ = ("verifier", "deadline", "ticket", "sigs")
+    __slots__ = ("verifier", "deadline", "ticket", "sigs", "cls",
+                 "tenant")
 
-    def __init__(self, verifier, deadline, sigs):
+    def __init__(self, verifier, deadline, sigs,
+                 cls=_tenancy.CLASS_MEMPOOL,
+                 tenant=_tenancy.DEFAULT_TENANT):
         self.verifier = verifier
         self.deadline = deadline  # absolute service-clock time or None
         self.ticket = VerifyTicket()
         self.sigs = sigs
+        self.cls = cls
+        self.tenant = tenant
 
 
 class _HostOnlyHealth(_health.DeviceHealth):
@@ -258,9 +273,13 @@ class VerifyService:
 
     Parameters (all optional — defaults serve a single-device node):
 
-    * capacity_sigs / high_watermark / low_watermark — admission
-      control: absolute signature capacity and the shed/resume
-      hysteresis fractions.
+    * capacity_sigs / high_watermark / low_watermark / rpc_watermark —
+      admission control: absolute signature capacity and the per-class
+      shed/resume hysteresis fractions (tenancy.class_policies —
+      high/low are the mempool class's pair, exactly the pre-tenancy
+      semantics; rpc sheds at its own lower watermark; consensus-class
+      never watermark-sheds).  Watermark defaults come from the
+      ED25519_TPU_CLASS_WATERMARK_* knobs.
     * wave_max_batches — max requests drained per dispatcher wave.
     * chunk / hybrid / merge / mesh / policy — forwarded to
       `verify_many` (mesh=None keeps auto-routing; an explicit mesh is
@@ -284,8 +303,9 @@ class VerifyService:
     race the snapshot; run them through the service instead)."""
 
     def __init__(self, *, capacity_sigs: int = 65536,
-                 high_watermark: float = 0.85,
+                 high_watermark: "float | None" = None,
                  low_watermark: float = 0.50,
+                 rpc_watermark: "float | None" = None,
                  wave_max_batches: int = 64,
                  chunk: int = 8, hybrid: bool = True, merge: str = "auto",
                  mesh: "int | None" = None,
@@ -297,12 +317,23 @@ class VerifyService:
                  breaker_seed: int = 0,
                  device_time_prior: float = 2.0,
                  rng=None, auto_start: bool = True):
-        if not 0.0 < low_watermark <= high_watermark <= 1.0:
-            raise ValueError(
-                "watermarks must satisfy 0 < low <= high <= 1")
+        # Per-class admission policy (tenancy.py): mempool keeps the
+        # (high, low) watermark pair — the exact pre-tenancy admission
+        # semantics and the class `submit()` defaults to — rpc sheds
+        # at its own lower watermark, consensus only at a full queue.
+        self.class_policies = _tenancy.class_policies(
+            high_watermark=high_watermark,
+            low_watermark=low_watermark,
+            rpc_watermark=rpc_watermark)
         self.capacity_sigs = int(capacity_sigs)
-        self._high_sigs = high_watermark * self.capacity_sigs
-        self._low_sigs = low_watermark * self.capacity_sigs
+        self._class_high = {
+            cls: (None if p.shed_watermark is None
+                  else p.shed_watermark * self.capacity_sigs)
+            for cls, p in self.class_policies.items()}
+        self._class_low = {
+            cls: (None if p.resume_watermark is None
+                  else p.resume_watermark * self.capacity_sigs)
+            for cls, p in self.class_policies.items()}
         self.wave_max_batches = int(wave_max_batches)
         self.chunk = chunk
         self.hybrid = hybrid
@@ -321,9 +352,14 @@ class VerifyService:
         self._host_health = _HostOnlyHealth(self._clock)
 
         self._cv = threading.Condition()
-        self._queue: "deque[_Request]" = deque()
+        # One FIFO queue per traffic class, drained in CLASSES priority
+        # order; _queue_sigs is the TOTAL depth every class's watermark
+        # is measured against (low classes react to overall pressure,
+        # whoever caused it).
+        self._queues: "dict[str, deque[_Request]]" = {
+            cls: deque() for cls in _tenancy.CLASSES}
         self._queue_sigs = 0
-        self._shedding = False
+        self._shedding_cls = {cls: False for cls in _tenancy.CLASSES}
         self._closed = False
         self.totals = {
             "submitted": 0, "resolved": 0, "rejected_overloaded": 0,
@@ -336,6 +372,14 @@ class VerifyService:
             # validator keyset recurs.
             "devcache_hot_waves": 0, "devcache_dispatch_hits": 0,
         }
+        # Per-class lifecycle tallies (the fairness surface the traffic
+        # lab and the SLO gates read): every submission lands in
+        # exactly one of submitted -> {resolved, rejected_overloaded,
+        # shed_deadline} within its class row.
+        self.by_class = {
+            cls: {"submitted": 0, "resolved": 0,
+                  "rejected_overloaded": 0, "shed_deadline": 0}
+            for cls in _tenancy.CLASSES}
         self._thread = None
         if auto_start:
             self._thread = threading.Thread(
@@ -349,18 +393,30 @@ class VerifyService:
         return self._clock.monotonic()
 
     def submit(self, entries, deadline: "float | None" = None,
-               timeout: "float | None" = None) -> VerifyTicket:
+               timeout: "float | None" = None,
+               cls: "str | None" = None,
+               tenant: "str | None" = None) -> VerifyTicket:
         """Submit one batch: a `batch.Verifier` (ownership transfers to
         the service — do not mutate or verify it afterwards) or an
         iterable of `(vk_bytes, sig, msg)` entries.  `deadline` is an
         absolute service-clock time, `timeout` a relative convenience
         (both given: the earlier wins); None means no deadline.
 
+        `cls` names the traffic class (tenancy.CLASSES; default
+        mempool — the pre-tenancy admission semantics): it decides the
+        admission watermark and the wave drain priority, NEVER the
+        verdict.  `tenant` tags the batch's recurring keyset for the
+        device operand cache's per-tenant residency quotas (cache
+        QoS); it too is purely a resource-placement hint.
+
         Returns a `VerifyTicket`; raises `Overloaded` when the bounded
-        queue cannot admit the batch (beyond capacity, or shedding
-        between the watermarks) and `ServiceClosed` after `close()`.
-        Admission is decided HERE, synchronously — an admitted request
-        is never later dropped for load."""
+        queue cannot admit the batch (beyond capacity, or the class is
+        shedding above its watermark) and `ServiceClosed` after
+        `close()`.  Admission is decided HERE, synchronously — an
+        admitted request is never later dropped for load."""
+        if cls is None:
+            cls = _tenancy.CLASS_MEMPOOL
+        _tenancy.class_rank(cls)  # unknown class names fail loudly
         if isinstance(entries, _batch.Verifier):
             v = entries
         else:
@@ -369,58 +425,119 @@ class VerifyService:
         if timeout is not None:
             t = self.now() + float(timeout)
             deadline = t if deadline is None else min(deadline, t)
-        req = _Request(v, deadline, v.batch_size)
+        req = _Request(v, deadline, v.batch_size, cls=cls,
+                       tenant=tenant if tenant is not None
+                       else _tenancy.DEFAULT_TENANT)
+        # Tenant assignment happens BEFORE enqueue: the verifier is
+        # still private here (after append the dispatcher may be
+        # staging it concurrently), and the partition must be on
+        # record before any dispatch could possibly build the keyset —
+        # an assignment landing after the enqueue could lose the race
+        # and build into the default partition, softening the
+        # never-cross-partition eviction guarantee until restage.  The
+        # map write is idempotent placement metadata keyed by digest,
+        # so a subsequently-rejected submission leaves nothing
+        # harmful behind.
+        if tenant is not None:
+            self._assign_tenant(v, tenant)
         with self._cv:
             if self._closed:
                 raise ServiceClosed()
             self.totals["submitted"] += 1
-            # Watermark hysteresis: crossing high arms shedding; only
-            # draining below low (dispatcher side) disarms it.
-            if self._queue_sigs >= self._high_sigs:
-                self._set_shedding(True)
-            if self._shedding:
+            self.by_class[cls]["submitted"] += 1
+            # Per-class watermark hysteresis over TOTAL depth: crossing
+            # the class's shed watermark arms shedding for THAT class;
+            # only draining below its resume watermark (dispatcher
+            # side) disarms it.  Consensus-class has no watermark —
+            # only the hard capacity check below can reject it.
+            high = self._class_high[cls]
+            if high is not None and self._queue_sigs >= high:
+                self._set_shedding(cls, True)
+            if self._shedding_cls[cls]:
                 self.totals["rejected_overloaded"] += 1
+                self.by_class[cls]["rejected_overloaded"] += 1
                 _metrics.record_fault("service_reject_overloaded")
+                _metrics.record_fault(
+                    f"service_reject_overloaded_{cls}")
                 raise Overloaded(
-                    f"shedding above high watermark "
+                    f"{cls}-class shedding above its watermark "
                     f"({self._queue_sigs} sigs queued)")
             if self._queue_sigs + req.sigs > self.capacity_sigs:
                 self.totals["rejected_overloaded"] += 1
+                self.by_class[cls]["rejected_overloaded"] += 1
                 _metrics.record_fault("service_reject_overloaded")
+                _metrics.record_fault(
+                    f"service_reject_overloaded_{cls}")
                 raise Overloaded(
                     f"queue full ({self._queue_sigs}+{req.sigs} "
                     f"> {self.capacity_sigs} sigs)")
-            self._queue.append(req)
+            self._queues[cls].append(req)
             self._queue_sigs += req.sigs
             self._update_gauges()
             self._cv.notify_all()
         return req.ticket
 
-    def _set_shedding(self, flag: bool) -> None:
+    def _assign_tenant(self, verifier, tenant: str) -> None:
+        """Tag the batch's keyset content address with its tenant
+        partition in the device operand cache (quota accounting,
+        devcache.py).  No-op when the cache is off or the verifier has
+        no canonical keyset blob (mixed construction paths) — those
+        batches simply stay in the default partition; placement is an
+        optimization hint, never correctness state."""
+        from . import devcache as _devcache
+
+        cache = _devcache.default_cache()
+        if not cache.enabled:
+            return
+        blob = verifier._canonical_keyset_blob()
+        if blob:
+            cache.assign_tenant(_devcache.keyset_digest(blob), tenant)
+
+    def _set_shedding(self, cls: str, flag: bool) -> None:
         # under self._cv
-        if self._shedding != flag:
-            self._shedding = flag
-            _metrics.set_gauge("service_shedding", int(flag))
+        if self._shedding_cls[cls] != flag:
+            self._shedding_cls[cls] = flag
+            _metrics.set_gauge(f"service_shedding_{cls}", int(flag))
+            _metrics.set_gauge(
+                "service_shedding",
+                int(any(self._shedding_cls.values())))
 
     def _update_gauges(self) -> None:
         # under self._cv
         _metrics.set_gauge("service_queue_sigs", self._queue_sigs)
-        _metrics.set_gauge("service_queue_requests", len(self._queue))
+        _metrics.set_gauge("service_queue_requests",
+                           sum(len(q) for q in self._queues.values()))
+        for cls, q in self._queues.items():
+            _metrics.set_gauge(f"service_queue_requests_{cls}", len(q))
 
     # -- dispatch ----------------------------------------------------------
+
+    def _queued_requests(self) -> int:
+        # under self._cv
+        return sum(len(q) for q in self._queues.values())
 
     def _take_wave(self, block: bool) -> "list[_Request]":
         with self._cv:
             if block:
-                while not self._queue and not self._closed:
+                while not self._queued_requests() and not self._closed:
                     self._cv.wait(0.05 if self._clock.virtual else None)
+            # Priority drain: consensus first, then mempool, then rpc
+            # (FIFO within each class) — under overload the wave is
+            # consensus-heavy by construction, which is what holds the
+            # high-class p99 while low classes queue and shed.
             wave = []
-            while self._queue and len(wave) < self.wave_max_batches:
-                req = self._queue.popleft()
-                self._queue_sigs -= req.sigs
-                wave.append(req)
-            if self._shedding and self._queue_sigs <= self._low_sigs:
-                self._set_shedding(False)
+            for cls in _tenancy.CLASSES:
+                q = self._queues[cls]
+                while q and len(wave) < self.wave_max_batches:
+                    req = q.popleft()
+                    self._queue_sigs -= req.sigs
+                    wave.append(req)
+            # Per-class hysteresis disarm: a class resumes admitting
+            # once TOTAL depth drains below its resume watermark.
+            for cls, low in self._class_low.items():
+                if (self._shedding_cls[cls] and low is not None
+                        and self._queue_sigs <= low):
+                    self._set_shedding(cls, False)
             self._update_gauges()
             return wave
 
@@ -440,6 +557,7 @@ class VerifyService:
                 # Shed BEFORE dispatch: expired requests must not spend
                 # device/host time, and must resolve explicitly.
                 self.totals["shed_deadline"] += 1
+                self.by_class[req.cls]["shed_deadline"] += 1
                 _metrics.record_fault("service_shed_deadline")
                 req.ticket._fail(DeadlineExceeded())
             else:
@@ -527,6 +645,7 @@ class VerifyService:
             else:
                 req.ticket._resolve(verdict)
             self.totals["resolved"] += 1
+            self.by_class[req.cls]["resolved"] += 1
 
     def _note_device_outcome(self, stats: dict, probe: bool) -> None:
         """Feed one device-routed wave's verify_many stats to the
@@ -565,23 +684,28 @@ class VerifyService:
     def _run(self) -> None:
         while True:
             with self._cv:
-                if self._closed and not self._queue:
+                if self._closed and not self._queued_requests():
                     return
             self.process_once(block=True)
 
     # -- lifecycle ---------------------------------------------------------
 
     def stats(self) -> dict:
-        """Snapshot: queue depth, admission state, breaker state, and
-        the lifetime totals."""
+        """Snapshot: queue depth, admission state, breaker state, the
+        lifetime totals, and the per-class fairness rows."""
         with self._cv:
             return {
                 "queue_sigs": self._queue_sigs,
-                "queue_requests": len(self._queue),
-                "shedding": self._shedding,
+                "queue_requests": self._queued_requests(),
+                "queue_requests_by_class": {
+                    cls: len(q) for cls, q in self._queues.items()},
+                "shedding": any(self._shedding_cls.values()),
+                "shedding_by_class": dict(self._shedding_cls),
                 "closed": self._closed,
                 "breaker_state": self.breaker.state,
                 "device_estimate_s": self._device_estimate,
+                "by_class": {cls: dict(row)
+                             for cls, row in self.by_class.items()},
                 **self.totals,
             }
 
@@ -594,14 +718,16 @@ class VerifyService:
         with self._cv:
             self._closed = True
             if not drain:
-                pending = list(self._queue)
-                self._queue.clear()
+                for q in self._queues.values():
+                    pending.extend(q)
+                    q.clear()
                 self._queue_sigs = 0
                 self._update_gauges()
             self._cv.notify_all()
         for req in pending:
             req.ticket._fail(ServiceClosed())
             self.totals["resolved"] += 1
+            self.by_class[req.cls]["resolved"] += 1
         if self._thread is not None:
             self._thread.join(timeout=60.0)
             self._thread = None

@@ -4,6 +4,7 @@ coalescing ratio m/n, per-stage wall times, and — since the round-6
 robustness work — process-cumulative fault/recovery counters fed by the
 verify_many degradation ladder)."""
 
+import math
 import threading
 import time
 from contextlib import contextmanager
@@ -89,6 +90,23 @@ def gauges() -> dict:
 def reset_gauges() -> None:
     with _gauge_lock:
         _gauges.clear()
+
+
+def percentiles(values, fractions=(0.5, 0.99, 0.999)) -> dict:
+    """Deterministic nearest-rank percentiles over a finite sample —
+    the p50/p99/p999 verdict-latency numbers the traffic lab's
+    `service_slo` block reports.  Nearest-rank (ceil(f·n)-th order
+    statistic) rather than interpolation: every reported value is an
+    actually-observed latency, and two runs over the same sample agree
+    bit-for-bit.  Returns {fraction: value}, with None values for an
+    empty sample."""
+    if not values:
+        return {f: None for f in fractions}
+    s = sorted(values)
+    return {
+        f: s[min(len(s) - 1, max(0, math.ceil(f * len(s)) - 1))]
+        for f in fractions
+    }
 
 
 class BatchMetrics:

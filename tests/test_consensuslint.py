@@ -162,6 +162,80 @@ def test_cl004_positive_out_of_scope_module():
     assert lint_fixture("ops/fixture.py", "_cache = {}\n") == []
 
 
+# -- tenancy.py / tools/traffic_lab.py in-scope fixtures -------------------
+# The multi-tenant round brought both modules under the catalog: tenant
+# and class state must be injectable (CL004), every timestamp comes from
+# an injected clock or the virtual timeline (CL002), and knobs go
+# through the registry (CL003).
+
+
+def lint_tool_fixture(relpath: str, source: str):
+    """Lint one in-memory fixture as if it lived at a REPO-relative
+    path outside the package (the traffic lab lives in tools/ and is
+    linted by explicit path in CI)."""
+    mod = linter.ParsedModule(path=f"<fixture:{relpath}>",
+                              source=source, relpath=relpath)
+    return linter.lint_module(mod)
+
+
+def test_cl004_negative_module_global_tenant_state():
+    """Ambient per-tenant state at module level is exactly the
+    cross-tenant leak the tenancy design forbids: quotas/epochs live on
+    the injectable cache and service objects."""
+    findings = lint_fixture("tenancy.py", "_tenant_epochs = {}\n")
+    assert rules_of(findings) == ["CL004"]
+    assert "_tenant_epochs" in findings[0].message
+
+
+def test_cl004_positive_tenancy_constants():
+    src = ("CLASSES = ('consensus', 'mempool', 'rpc')\n"
+           "def class_rank(cls):\n"
+           "    return CLASSES.index(cls)\n")
+    assert lint_fixture("tenancy.py", src) == []
+
+
+def test_cl002_negative_traffic_lab_raw_clock():
+    src = ("import time\n"
+           "def lab_tick():\n"
+           "    return time.monotonic()\n")
+    findings = lint_tool_fixture("tools/traffic_lab.py", src)
+    assert rules_of(findings) == ["CL002"]
+
+
+def test_cl002_positive_traffic_lab_injected_clock():
+    src = ("def lab_tick(clock):\n"
+           "    return clock.monotonic()\n")
+    assert lint_tool_fixture("tools/traffic_lab.py", src) == []
+
+
+def test_cl004_negative_traffic_lab_module_global():
+    findings = lint_tool_fixture("tools/traffic_lab.py",
+                                 "_lab_results = []\n")
+    assert rules_of(findings) == ["CL004"]
+
+
+def test_cl006_negative_tenancy_overbroad_except():
+    src = ("def resolve(cls):\n"
+           "    try:\n"
+           "        return rank(cls)\n"
+           "    except Exception:\n"
+           "        return 0\n")
+    assert rules_of(lint_fixture("tenancy.py", src)) == ["CL006"]
+
+
+def test_real_tenancy_and_traffic_lab_lint_clean():
+    """The shipped modules themselves hold the contract they are now
+    scoped under."""
+    import os
+
+    paths = [
+        os.path.join(linter.PACKAGE_ROOT, "tenancy.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "traffic_lab.py"),
+    ]
+    findings = linter.lint_paths(paths)
+    assert findings == [], [str(f) for f in findings]
+
+
 # -- CL005: secret hygiene -------------------------------------------------
 
 def test_cl005_negative_repr_leaks_scalar():
@@ -540,13 +614,20 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 16 knobs (13 + the three
-    ED25519_TPU_DEVCACHE_* knobs from the round-7 operand cache)."""
+    these rows) and the registry knows all 20 knobs (16 through the
+    round-7 operand cache + the four multi-tenancy knobs: the devcache
+    tenant quota, the two class watermarks, and the traffic-lab
+    seed)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 16
+    assert len(rows) == len(config.KNOBS) == 20
     assert all(doc for (_, _, _, doc) in rows)
+    for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
+                 "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
+                 "ED25519_TPU_CLASS_WATERMARK_RPC",
+                 "ED25519_TPU_TRAFFIC_LAB_SEED"):
+        assert name in config.KNOBS
 
 
 # -- the CLI exit-code contract --------------------------------------------

@@ -63,11 +63,11 @@ def test_point_op_parity():
         assert limbs.unpack_point(Dbl[..., j]) == pts1[j].double()
 
 
-def test_device_msm_matches_host():
+def _device_msm_matches_host_at(sizes):
     from ed25519_consensus_tpu.ops import msm
 
     tors = edwards.eight_torsion()
-    for n in (1, 3, 8):
+    for n in sizes:
         pts = [edwards.BASEPOINT.scalar_mul(rng.randrange(1, L))
                for _ in range(max(0, n - 2))] + tors[4:4 + min(n, 2)]
         pts = pts[:n]
@@ -77,6 +77,19 @@ def test_device_msm_matches_host():
             sc[0] = 0
             sc[1] = 1
         assert msm.device_msm(sc, pts) == edwards.multiscalar_mul(sc, pts)
+
+
+def test_device_msm_matches_host():
+    """Representative in-budget shape: n=8 carries torsion points plus
+    the zero/one edge scalars through one kernel compile.  The full
+    (1, 3, 8) size sweep — one compile per padded shape — rides the
+    slow-marked sweep below (tier-1 window audit, ROADMAP item 5)."""
+    _device_msm_matches_host_at((8,))
+
+
+@pytest.mark.slow
+def test_device_msm_matches_host_full_sweep():
+    _device_msm_matches_host_at((1, 3, 8))
 
 
 def test_batch_verify_device_backend():
@@ -99,13 +112,7 @@ def test_batch_verify_device_backend_rejects_bad():
         bv.verify_tpu(rng=rng)
 
 
-def test_compressed_wire_matches_affine_wire():
-    """Round-4 compressed (33 B/term y+hint) wire vs the affine X‖Y
-    wire: the SAME staged batch dispatched through both formats must
-    yield identical window sums — covering on-device x-recomputation
-    for torsion keys, non-canonical encodings (ZIP215 y ≥ p), split
-    coefficient terms (cached shift-point encodings), and identity
-    padding."""
+def _wire_ab_staged():
     from ed25519_consensus_tpu.ops import msm
     from ed25519_consensus_tpu.utils import fixtures
 
@@ -123,9 +130,33 @@ def test_compressed_wire_matches_affine_wire():
                                            wire="compressed")
     dig_a, wire_a = staged.device_operands(msm.preferred_pad,
                                            wire="affine")
+    return staged, (dig_c, wire_c), (dig_a, wire_a)
+
+
+def test_compressed_wire_staging_matches_affine():
+    """In-budget half of the wire-format conformance pair: the SAME
+    staged batch produces byte-identical digit planes under both wire
+    formats, with the torsion/non-canonical/split-term key material in
+    the batch.  The two-executable device dispatch cross-check is the
+    slow-marked sweep below (one kernel compile per wire format —
+    tier-1 window audit, ROADMAP item 5)."""
+    _, (dig_c, wire_c), (dig_a, wire_a) = _wire_ab_staged()
     assert wire_c.shape[0] == 33 and wire_c.dtype == np.uint8
     assert wire_a.shape[0] == 2
     assert np.array_equal(dig_c, dig_a)
+
+
+@pytest.mark.slow
+def test_compressed_wire_matches_affine_wire():
+    """Round-4 compressed (33 B/term y+hint) wire vs the affine X‖Y
+    wire: the SAME staged batch dispatched through both formats must
+    yield identical window sums — covering on-device x-recomputation
+    for torsion keys, non-canonical encodings (ZIP215 y ≥ p), split
+    coefficient terms (cached shift-point encodings), and identity
+    padding."""
+    from ed25519_consensus_tpu.ops import msm
+
+    staged, (dig_c, wire_c), (dig_a, wire_a) = _wire_ab_staged()
     out_c = np.asarray(msm.dispatch_window_sums(dig_c, wire_c))
     out_a = np.asarray(msm.dispatch_window_sums(dig_a, wire_a))
     got_c = msm.combine_window_sums(out_c)
@@ -135,13 +166,8 @@ def test_compressed_wire_matches_affine_wire():
     assert got_c == staged.host_msm()
 
 
-def test_packed_digit_wire_matches_plain(monkeypatch):
-    """Round-4 nibble-packed digit wire (17 B/term) vs the plain
-    one-digit-per-byte planes: the SAME staged batch dispatched through
-    both digit formats must yield identical window sums — covering the
-    in-jit expand (ops/msm.py expand_digits) over split coefficient
-    terms, full-width scalars, and zero padding lanes."""
-    from ed25519_consensus_tpu.ops import limbs, msm
+def _digit_wire_staged(monkeypatch):
+    from ed25519_consensus_tpu.ops import msm
 
     bv = batch.Verifier()
     keys = [SigningKey.new(rng) for _ in range(3)]
@@ -154,12 +180,38 @@ def test_packed_digit_wire_matches_plain(monkeypatch):
     dig_p, pts_p = staged.device_operands(msm.preferred_pad)
     monkeypatch.setenv("ED25519_TPU_DIGIT_WIRE", "packed")
     dig_k, pts_k = staged.device_operands(msm.preferred_pad)
+    return staged, (dig_p, pts_p), (dig_k, pts_k)
+
+
+def test_packed_digit_wire_expand_matches_plain(monkeypatch):
+    """In-budget half of the digit-wire conformance pair: the packed
+    (17 B/term) planes expand host-side bit-exactly to the plain
+    one-digit-per-byte planes over split coefficient terms, full-width
+    scalars, and padding lanes.  The two-executable device dispatch
+    cross-check is the slow-marked sweep below (tier-1 window audit,
+    ROADMAP item 5)."""
+    from ed25519_consensus_tpu.ops import limbs, msm
+
+    _, (dig_p, _), (dig_k, _) = _digit_wire_staged(monkeypatch)
     assert dig_p.shape[0] == limbs.NWINDOWS
     assert dig_k.shape[0] == limbs.PACKED_WINDOWS
     assert msm.digit_wire_of(dig_p) == "plain"
     assert msm.digit_wire_of(dig_k) == "packed"
     # host-side inverse agrees bit-exactly
     assert np.array_equal(np.asarray(msm.expand_digits(dig_k)), dig_p)
+
+
+@pytest.mark.slow
+def test_packed_digit_wire_matches_plain(monkeypatch):
+    """Round-4 nibble-packed digit wire (17 B/term) vs the plain
+    one-digit-per-byte planes: the SAME staged batch dispatched through
+    both digit formats must yield identical window sums — covering the
+    in-jit expand (ops/msm.py expand_digits) over split coefficient
+    terms, full-width scalars, and zero padding lanes."""
+    from ed25519_consensus_tpu.ops import msm
+
+    staged, (dig_p, pts_p), (dig_k, pts_k) = _digit_wire_staged(
+        monkeypatch)
     out_p = np.asarray(msm.dispatch_window_sums(dig_p, pts_p))
     out_k = np.asarray(msm.dispatch_window_sums(dig_k, pts_k))
     assert np.array_equal(out_p, out_k)
@@ -182,14 +234,22 @@ def test_verify_many_pad_covers_split_terms():
     assert batch.verify_many(vs, rng=rng) == [True, False]
 
 
-def test_small_order_matrix_device_parity():
-    """Every conformance-matrix case through the DEVICE path: batch-of-one
-    verdicts must equal the host-path verdicts (all valid under ZIP215).
-    Also queues the full matrix as ONE device batch."""
+def _matrix_encodings():
     from ed25519_consensus_tpu.utils import fixtures
 
     encs = [p.compress() for p in edwards.eight_torsion()]
     encs += fixtures.non_canonical_point_encodings()[:6]
+    return encs
+
+
+def test_small_order_matrix_device_parity():
+    """Conformance-matrix cases through the DEVICE path, in-budget
+    form: batch-of-one verdicts for a rotated (A, R) sample (all valid
+    under ZIP215) plus a stride-3 SUBSET of the matrix as one coalesced
+    device batch — every torsion and non-canonical A still appears.
+    The full 196-case single-batch form (a second, larger kernel
+    compile) is the slow-marked sweep below."""
+    encs = _matrix_encodings()
     s_bytes = b"\x00" * 32
 
     # Batch-of-one device verdicts for a representative sample (every A
@@ -200,7 +260,24 @@ def test_small_order_matrix_device_parity():
         bv.queue((A_bytes, Signature(R_bytes, s_bytes), b"Zcash"))
         bv.verify(rng=rng, backend="device")  # ZIP215: must accept
 
-    # The full 196-case matrix as one coalesced device batch.
+    # A stride-3 subset of the matrix as one coalesced device batch.
+    bv = batch.Verifier()
+    for i, A_bytes in enumerate(encs):
+        for j, R_bytes in enumerate(encs):
+            if (i * len(encs) + j) % 3 == 0:
+                bv.queue((A_bytes, Signature(R_bytes, s_bytes),
+                          b"Zcash"))
+    assert bv.batch_size >= 196 // 4
+    bv.verify(rng=rng, backend="device")
+
+
+@pytest.mark.slow
+def test_small_order_matrix_device_parity_full():
+    """The full 196-case matrix as one coalesced device batch (its own
+    padded-shape kernel compile, hence the slow mark; the tier-1 quick
+    run covers the stride-3 subset above)."""
+    encs = _matrix_encodings()
+    s_bytes = b"\x00" * 32
     bv = batch.Verifier()
     for A_bytes in encs:
         for R_bytes in encs:

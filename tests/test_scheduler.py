@@ -32,11 +32,16 @@ rng = random.Random(0x5C4ED)
 
 @pytest.fixture(autouse=True)
 def reset_device_state():
-    """Reset the per-mesh scheduler health state (cooldowns, lane
-    registry, the process lane-stuck latch) so tests are
-    order-independent."""
+    """Reset the per-mesh scheduler health state (cooldowns, the
+    process lane-stuck latch) so tests are order-independent.  Lane
+    WORKERS stay alive across tests (the PR 5 session-reuse idiom from
+    test_devcache.py — a per-test reset_all() join costs seconds per
+    teardown); only a test that abandoned a worker (lane marked stuck)
+    pays the join, because a parked worker could hold the device call
+    lock into the next test."""
     yield
-    batch._DeviceLane.reset_all()
+    if health.any_lane_stuck():
+        batch._DeviceLane.reset_all()
     batch.reset_device_health()
     batch.last_run_stats.clear()
 
@@ -634,8 +639,13 @@ def test_merge_does_not_mutate_members():
 def test_warm_device_shapes_compiles_scheduler_shapes(monkeypatch):
     """warm_device_shapes must dispatch exactly ONE batch shape — the
     full (chunk, N) every scheduler dispatch (probe included) is padded
-    to — and never raise on failure."""
+    to — and never raise on failure.  With the devcache enabled it
+    additionally warms the hot-path executable, whose on-device
+    assemble feeds the SAME inner kernel dispatch once more (ops/msm
+    dispatch_window_sums_many_cached), still at the full chunk."""
     import numpy as np
+
+    from ed25519_consensus_tpu import devcache
 
     main_thread = threading.get_ident()
     shapes = []
@@ -652,10 +662,23 @@ def test_warm_device_shapes_compiles_scheduler_shapes(monkeypatch):
 
     monkeypatch.setattr(msm, "dispatch_window_sums_many", spy)
     vs = make_verifiers(1, sigs_per_batch=3)
+    # Cache OFF: the original single-dispatch contract, bit-exact.
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE", "0")
+    devcache.set_default_cache(None)  # re-derive from env
     batch.warm_device_shapes(vs[0], rng=rng, chunk=4)
     # ONE executable shape: everything (probe included) is padded to the
     # full chunk, so warming dispatches exactly that shape once.
     assert [s[0] for s in shapes] == [4]
+
+    # Cache ON (the production default): the devcache hot-path warm
+    # rides the same inner kernel dispatch once more — both dispatches
+    # at the full chunk, nothing else.
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE", "1")
+    devcache.set_default_cache(None)
+    shapes.clear()
+    batch.warm_device_shapes(vs[0], rng=rng, chunk=4)
+    assert [s[0] for s in shapes] == [4, 4]
+    devcache.set_default_cache(None)  # later tests re-derive fresh
 
     # failure safety: a raising dispatch must not propagate
     def boom(digits, pts):

@@ -14,7 +14,14 @@ import threading
 
 import pytest
 
-from ed25519_consensus_tpu import SigningKey, batch, health, service
+from ed25519_consensus_tpu import (
+    SigningKey,
+    batch,
+    devcache,
+    health,
+    service,
+    tenancy,
+)
 from ed25519_consensus_tpu.ops import msm
 from ed25519_consensus_tpu.utils import metrics
 
@@ -28,7 +35,13 @@ def reset_device_state(monkeypatch):
     # exercise the device path clear this env override themselves.
     monkeypatch.setenv("ED25519_TPU_DISABLE_DEVICE", "1")
     yield
-    batch._DeviceLane.reset_all()
+    # Lane workers stay alive across tests (the PR 5 session-reuse
+    # idiom from test_devcache.py): a per-test reset_all() pays a
+    # multi-second join per teardown and re-warms nothing of value.
+    # The one case that must not leak is an ABANDONED worker (a test
+    # that marked a lane stuck) — join those, and only those.
+    if health.any_lane_stuck():
+        batch._DeviceLane.reset_all()
     batch.reset_device_health()
     batch.last_run_stats.clear()
 
@@ -373,6 +386,178 @@ def test_queue_gauges_track_depth():
     assert g["service_queue_sigs"] == 0
     assert g["service_queue_requests"] == 0
     svc.close()
+
+
+# -- per-class queues: priority-aware admission + dispatch -----------------
+
+
+def test_unknown_class_rejected_loudly():
+    svc, fc = make_service()
+    with pytest.raises(ValueError, match="unknown traffic class"):
+        svc.submit(entries_for(b"x"), cls="spam")
+    svc.close()
+
+
+def test_wave_drains_in_priority_order():
+    """Strict priority: with one-request waves, queued rpc and mempool
+    wait while consensus drains first — whatever order they arrived
+    in."""
+    svc, fc = make_service(wave_max_batches=1)
+    t_rpc = svc.submit(entries_for(b"r"), cls=tenancy.CLASS_RPC)
+    t_mem = svc.submit(entries_for(b"m"))  # default: mempool
+    t_con = svc.submit(entries_for(b"c"), cls=tenancy.CLASS_CONSENSUS)
+    svc.process_once()
+    assert t_con.done()
+    assert not t_mem.done() and not t_rpc.done()
+    svc.process_once()
+    assert t_mem.done() and not t_rpc.done()
+    svc.process_once()
+    assert t_rpc.done()
+    assert all(t.result(5) for t in (t_con, t_mem, t_rpc))
+    st = svc.stats()
+    assert st["by_class"]["consensus"]["resolved"] == 1
+    assert st["by_class"]["rpc"]["resolved"] == 1
+    svc.close()
+
+
+def test_rpc_sheds_first_at_its_own_watermark():
+    """Depth crossing the rpc watermark (0.5 here) sheds NEW rpc
+    submissions while mempool (0.85) and consensus still admit — the
+    priority-aware shedding shape of the ladder's admit rung."""
+    svc, fc = make_service(capacity_sigs=100, high_watermark=0.85,
+                           low_watermark=0.5, rpc_watermark=0.5)
+    svc.submit(entries_for(b"fill", n=60))  # depth 60 >= rpc wm 50
+    with pytest.raises(service.Overloaded, match="rpc-class"):
+        svc.submit(entries_for(b"r", n=1), cls=tenancy.CLASS_RPC)
+    # mempool and consensus still admit at this depth
+    t_mem = svc.submit(entries_for(b"m", n=1))
+    t_con = svc.submit(entries_for(b"c", n=1),
+                       cls=tenancy.CLASS_CONSENSUS)
+    st = svc.stats()
+    assert st["shedding_by_class"]["rpc"] is True
+    assert st["shedding_by_class"]["mempool"] is False
+    assert st["by_class"]["rpc"]["rejected_overloaded"] == 1
+    assert metrics.fault_counters().get(
+        "service_reject_overloaded_rpc", 0) >= 1
+    while svc.process_once():
+        pass
+    assert t_mem.result(5) and t_con.result(5)
+    svc.close()
+
+
+def test_consensus_admits_until_queue_physically_full():
+    """Consensus-class has NO watermark: it admits through depths that
+    shed both lower classes, and only the hard capacity check can
+    reject it."""
+    svc, fc = make_service(capacity_sigs=100, high_watermark=0.8,
+                           low_watermark=0.4, rpc_watermark=0.5)
+    svc.submit(entries_for(b"fill", n=90))  # above BOTH watermarks
+    with pytest.raises(service.Overloaded):
+        svc.submit(entries_for(b"m", n=1))  # mempool sheds
+    with pytest.raises(service.Overloaded):
+        svc.submit(entries_for(b"r", n=1), cls=tenancy.CLASS_RPC)
+    t = svc.submit(entries_for(b"c", n=10),
+                   cls=tenancy.CLASS_CONSENSUS)  # exactly to capacity
+    with pytest.raises(service.Overloaded, match="queue full"):
+        svc.submit(entries_for(b"c2", n=1),
+                   cls=tenancy.CLASS_CONSENSUS)
+    st = svc.stats()
+    assert st["shedding_by_class"]["consensus"] is False  # never armed
+    assert st["by_class"]["consensus"]["rejected_overloaded"] == 1
+    while svc.process_once():
+        pass
+    assert t.result(5) is True
+    svc.close()
+
+
+def test_per_class_hysteresis_disarms_independently():
+    """rpc disarms at its (scaled) resume watermark while mempool —
+    armed later, resuming lower — stays shedding until the queue
+    drains further."""
+    svc, fc = make_service(capacity_sigs=100, high_watermark=0.8,
+                           low_watermark=0.6, rpc_watermark=0.5)
+    # rpc resume = 0.5 * (0.6/0.8) = 0.375 -> 37.5 sigs
+    tickets = [svc.submit(entries_for(b"%d" % i, n=20))
+               for i in range(4)]  # depth 80 = mempool high
+    with pytest.raises(service.Overloaded):
+        svc.submit(entries_for(b"r"), cls=tenancy.CLASS_RPC)
+    with pytest.raises(service.Overloaded):
+        svc.submit(entries_for(b"m"))
+    st = svc.stats()
+    assert st["shedding_by_class"] == {
+        "consensus": False, "mempool": True, "rpc": True}
+    svc.process_once()  # one wave drains everything below both resumes
+    st = svc.stats()
+    assert st["queue_sigs"] == 0
+    assert st["shedding_by_class"]["mempool"] is False
+    assert st["shedding_by_class"]["rpc"] is False
+    assert all(t.result(5) for t in tickets)
+    svc.close()
+
+
+def test_mixed_class_wave_all_classes_resolve_and_deadlines_apply():
+    """Deadline shedding composes with classes: the expired rpc request
+    sheds with DeadlineExceeded, per-class tallies split the outcome,
+    and verdicts are class-blind."""
+    svc, fc = make_service()
+    t_con = svc.submit(entries_for(b"c", bad=True),
+                       cls=tenancy.CLASS_CONSENSUS)
+    t_rpc = svc.submit(entries_for(b"r"), cls=tenancy.CLASS_RPC,
+                       timeout=5.0)
+    fc.advance(6.0)
+    svc.process_once()
+    assert t_con.result(5) is False  # tampered: verdict, not an error
+    with pytest.raises(service.DeadlineExceeded):
+        t_rpc.result(5)
+    st = svc.stats()
+    assert st["by_class"]["rpc"]["shed_deadline"] == 1
+    assert st["by_class"]["consensus"]["shed_deadline"] == 0
+    svc.close()
+
+
+def test_close_without_drain_accounts_classes():
+    svc, fc = make_service()
+    svc.submit(entries_for(b"c"), cls=tenancy.CLASS_CONSENSUS)
+    svc.submit(entries_for(b"r"), cls=tenancy.CLASS_RPC)
+    svc.close(drain=False)
+    st = svc.stats()
+    assert st["by_class"]["consensus"]["resolved"] == 1
+    assert st["by_class"]["rpc"]["resolved"] == 1
+
+
+def test_class_queue_gauges_published():
+    svc, fc = make_service()
+    svc.submit(entries_for(b"c", n=3), cls=tenancy.CLASS_CONSENSUS)
+    svc.submit(entries_for(b"r", n=2), cls=tenancy.CLASS_RPC)
+    g = metrics.gauges()
+    assert g["service_queue_requests_consensus"] == 1
+    assert g["service_queue_requests_rpc"] == 1
+    assert g["service_queue_sigs"] == 5
+    svc.process_once()
+    g = metrics.gauges()
+    assert g["service_queue_requests_consensus"] == 0
+    svc.close()
+
+
+def test_submit_tenant_tags_devcache_partition():
+    """submit(tenant=...) registers the batch's keyset digest with the
+    device operand cache's quota accounting — placement only, the
+    verdict path never sees it."""
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 20,
+                                        enabled=True)
+    devcache.set_default_cache(cache)
+    try:
+        svc, fc = make_service()
+        v = batch.Verifier()
+        v.queue_bulk(entries_for(b"t"))
+        digest = devcache.keyset_digest(v._canonical_keyset_blob())
+        t = svc.submit(v, tenant="chain-a")
+        assert cache.tenant_of(digest) == "chain-a"
+        svc.process_once()
+        assert t.result(5) is True
+        svc.close()
+    finally:
+        devcache.set_default_cache(None)
 
 
 # -- verify_single_many invalidation API (satellite regression) ------------

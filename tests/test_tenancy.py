@@ -1,0 +1,486 @@
+"""Multi-tenant cache QoS + epoch-rotation survival (tenancy.py,
+devcache.py tenant partitions, faults.RotateTenant).
+
+The two consensus rules under test:
+
+* **Isolation** — with per-tenant quotas armed, one tenant's keyset
+  churn (including epoch rotation) can NEVER evict or stale another
+  tenant's resident entries: tenant B's hit rate is unchanged while
+  tenant A churns (the ROADMAP item-4 fairness gate).
+* **Verdict transparency** — a rotation landing MID-WAVE (between
+  staging and dispatch, via the SITE_DEVCACHE rotation fault) degrades
+  the rotated tenant to cold staging and nothing else: forced-device
+  verdicts stay bit-identical to the host oracle on the small-order
+  conformance-matrix subset and ordinary recurring batches, single
+  device and on the 8-device virtual mesh.
+
+Arrival-process determinism for the traffic lab's schedules is pinned
+here too (pure functions of the seed, tools/traffic_lab.py relies on
+it)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import (
+    SigningKey,
+    batch,
+    devcache,
+    faults,
+    health,
+    tenancy,
+)
+
+jax = pytest.importorskip("jax")
+
+rng = random.Random(0x7E4A47)
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    """Fresh injected cache per test; lane workers stay alive across
+    tests (the PR 5 session-reuse idiom); raised EMA prior is the
+    fault-suite idiom (see tests/test_devcache.py)."""
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 26,
+                                        enabled=True)
+    devcache.set_default_cache(cache)
+    yield cache
+    faults.uninstall()
+    devcache.set_default_cache(None)
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+
+
+def _require_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"need {n} devices, have {len(jax.devices())}")
+
+
+# -- workload builders (the test_devcache idiom, two tenants) --------------
+
+_KEYS_A = [SigningKey.new(rng) for _ in range(6)]
+_KEYS_B = [SigningKey.new(rng) for _ in range(6)]
+_KEYS_A2 = [SigningKey.new(rng) for _ in range(6)]  # A's post-rotation set
+
+
+def tenant_verifier(keys, tag: bytes, bad: bool = False):
+    v = batch.Verifier()
+    for i, sk in enumerate(keys):
+        msg = b"tenancy-%s-%d" % (tag, i)
+        sig = sk.sign(msg if not (bad and i == 0) else b"tampered")
+        v.queue((sk.verification_key_bytes(), sig, msg))
+    return v
+
+
+def digest_of(keys):
+    v = tenant_verifier(keys, b"digest")
+    return devcache.keyset_digest(v._canonical_keyset_blob())
+
+
+def matrix_verifier(subset_stride: int = 4):
+    """Small-order conformance-matrix subset (test_devcache idiom):
+    torsion/non-canonical keys, s = 0, all valid under ZIP215."""
+    from ed25519_consensus_tpu import Signature
+    from ed25519_consensus_tpu.ops import edwards
+    from ed25519_consensus_tpu.utils import fixtures
+
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    s_bytes = b"\x00" * 32
+    v = batch.Verifier()
+    for i, A_bytes in enumerate(encs):
+        for j, R_bytes in enumerate(encs):
+            if (i * len(encs) + j) % subset_stride == 0:
+                v.queue((A_bytes, Signature(R_bytes, s_bytes), b"Zcash"))
+    return v
+
+
+def host_verdicts(vs):
+    return [batch._host_verdict(v, rng) for v in vs]
+
+
+def run_forced_device(vs, mesh=0):
+    return batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                             merge="never", mesh=mesh)
+
+
+# -- tenancy data layer ----------------------------------------------------
+
+def test_class_order_and_rank():
+    assert tenancy.CLASSES == ("consensus", "mempool", "rpc")
+    assert tenancy.class_rank("consensus") == 0
+    assert tenancy.class_rank("rpc") == 2
+    with pytest.raises(ValueError, match="unknown traffic class"):
+        tenancy.class_rank("spam")
+
+
+def test_class_policies_shapes_and_validation():
+    pol = tenancy.class_policies(high_watermark=0.8, low_watermark=0.4,
+                                 rpc_watermark=0.5)
+    assert pol["consensus"].shed_watermark is None
+    assert pol["mempool"].shed_watermark == 0.8
+    assert pol["rpc"].shed_watermark == 0.5
+    # same shed:resume hysteresis ratio at every watermark-shedding rung
+    assert pol["rpc"].resume_watermark == pytest.approx(0.5 * 0.4 / 0.8)
+    with pytest.raises(ValueError, match="rpc"):
+        tenancy.class_policies(high_watermark=0.5, rpc_watermark=0.9)
+    with pytest.raises(ValueError):
+        tenancy.class_policies(high_watermark=0.4, low_watermark=0.8)
+
+
+def test_defaulted_rpc_watermark_clamps_to_low_mempool_high():
+    """Back-compat: a caller tuning high below the rpc knob's 0.5
+    default (legal before multi-tenancy) must keep constructing — the
+    knob-defaulted rpc watermark clamps to high (rpc then sheds
+    together with mempool); only an EXPLICIT rpc > high raises."""
+    pol = tenancy.class_policies(high_watermark=0.4, low_watermark=0.2)
+    assert pol["rpc"].shed_watermark == 0.4
+    from ed25519_consensus_tpu import service
+
+    svc = service.VerifyService(capacity_sigs=10, high_watermark=0.4,
+                                low_watermark=0.2, auto_start=False)
+    svc.close()
+    with pytest.raises(ValueError, match="rpc"):
+        tenancy.class_policies(high_watermark=0.4, low_watermark=0.2,
+                               rpc_watermark=0.5)
+
+
+def test_oversized_tensor_with_quota_off_is_silent_cold_stage():
+    """Pre-tenancy behavior preserved: quotas off, tensor over the
+    global budget → None with NO quota_rejected noise."""
+    head = np.zeros((4, 20, 8), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=head.nbytes // 2, enabled=True,
+        tenant_quota_bytes=0)
+    assert cache.build(devcache.keyset_digest(b"z" * 32), 1, head) is None
+    assert cache.counters["quota_rejected"] == 0
+
+
+def test_class_policy_defaults_come_from_config(monkeypatch):
+    monkeypatch.setenv("ED25519_TPU_CLASS_WATERMARK_RPC", "0.25")
+    monkeypatch.setenv("ED25519_TPU_CLASS_WATERMARK_MEMPOOL", "0.75")
+    pol = tenancy.class_policies()
+    assert pol["rpc"].shed_watermark == 0.25
+    assert pol["mempool"].shed_watermark == 0.75
+
+
+def test_arrival_processes_deterministic_and_shaped():
+    a1 = tenancy.poisson_arrivals(10.0, 30.0, seed=7)
+    a2 = tenancy.poisson_arrivals(10.0, 30.0, seed=7)
+    a3 = tenancy.poisson_arrivals(10.0, 30.0, seed=8)
+    assert a1 == a2 and a1 != a3          # replay / decorrelate
+    assert all(0.0 <= t < 30.0 for t in a1)
+    assert a1 == sorted(a1)
+    # mean count within loose bounds (300 expected)
+    assert 150 < len(a1) < 500
+
+    b1 = tenancy.burst_arrivals(10.0, 30.0, seed=7, burst_every=10.0,
+                                burst_len=2.0, burst_factor=5.0)
+    assert b1 == tenancy.burst_arrivals(10.0, 30.0, seed=7,
+                                        burst_every=10.0, burst_len=2.0,
+                                        burst_factor=5.0)
+    in_burst = sum(1 for t in b1 if (t % 10.0) < 2.0)
+    # burst windows are 20% of the horizon at 5x rate: they must carry
+    # the majority of arrivals
+    assert in_burst > len(b1) // 2
+
+    d1 = tenancy.diurnal_arrivals(10.0, 30.0, seed=7, period=30.0,
+                                  amplitude=0.9)
+    assert d1 == tenancy.diurnal_arrivals(10.0, 30.0, seed=7,
+                                          period=30.0, amplitude=0.9)
+    # rate peaks in the first half-period, troughs in the second
+    first = sum(1 for t in d1 if t < 15.0)
+    assert first > len(d1) - first
+
+    with pytest.raises(ValueError, match="unknown arrival kind"):
+        tenancy.arrivals("lunar", 1.0, 1.0)
+
+
+# -- per-tenant quota: isolation under churn -------------------------------
+
+def test_quota_partitions_evictions_to_the_churning_tenant():
+    """Tenant A churns through rotating keysets; tenant B's single hot
+    entry must survive every eviction A's churn causes, and B's hit
+    rate must be unchanged (the item-4 fairness gate, unit form)."""
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=3 * head.nbytes, enabled=True,
+        tenant_quota_bytes=int(1.5 * head.nbytes))
+    d_b = devcache.keyset_digest(b"B" * 32)
+    cache.assign_tenant(d_b, "chain-b")
+    cache.build(d_b, 1, head)
+    hits_b = 0
+    for i in range(8):  # A churn: every build evicts A's previous entry
+        d_a = devcache.keyset_digest(bytes([i]) * 32)
+        cache.assign_tenant(d_a, "chain-a")
+        cache.build(d_a, 1, head)
+        assert cache.lookup(d_b) is not None
+        hits_b += 1
+    ts = cache.tenant_stats()
+    assert ts["chain-b"]["hits"] == hits_b
+    assert ts["chain-b"]["hit_rate"] == 1.0
+    assert ts["chain-b"]["evictions"] == 0
+    assert ts["chain-b"]["resident_keysets"] == 1
+    assert ts["chain-a"]["evictions"] == 7  # strictly inside A
+
+
+def test_quota_refuses_cross_tenant_eviction_when_budget_full():
+    """Quotas oversubscribing the budget must refuse residency
+    (quota_rejected, cold staging) rather than ever evict another
+    tenant's bytes."""
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=2 * head.nbytes, enabled=True,
+        tenant_quota_bytes=2 * head.nbytes)
+    dx1 = devcache.keyset_digest(b"x1" + b"\0" * 30)
+    dx2 = devcache.keyset_digest(b"x2" + b"\0" * 30)
+    dy = devcache.keyset_digest(b"y1" + b"\0" * 30)
+    for d in (dx1, dx2):
+        cache.assign_tenant(d, "X")
+        assert cache.build(d, 1, head) is not None
+    cache.assign_tenant(dy, "Y")
+    assert cache.build(dy, 1, head) is None
+    assert cache.counters["quota_rejected"] == 1
+    assert cache.lookup(dx1) is not None and cache.lookup(dx2) is not None
+    assert cache.tenant_stats()["Y"]["quota_rejected"] == 1
+
+
+def test_entry_larger_than_quota_never_resident_and_counted():
+    """An over-quota tensor is refused AND the refusal is visible on
+    the fairness surface (quota_rejected, per-tenant) — an operator
+    diagnosing a permanently-cold tenant must see why."""
+    head = np.zeros((4, 20, 8), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=4 * head.nbytes, enabled=True,
+        tenant_quota_bytes=head.nbytes // 2)
+    d = devcache.keyset_digest(b"big" + b"\0" * 29)
+    cache.assign_tenant(d, "whale")
+    assert cache.build(d, 1, head) is None
+    assert cache.counters["quota_rejected"] == 1
+    assert cache.tenant_stats()["whale"]["quota_rejected"] == 1
+
+
+def test_quota_refusal_leaves_own_residency_intact():
+    """Regression: a refused build (other tenants' bytes crowd the new
+    tensor out of the budget) must leave the building tenant's OWN hot
+    entry exactly as it found it — refusal means 'stay on cold staging
+    for the new keyset', never 'destroy the residency you could not
+    replace'.  Needs heterogeneous keyset sizes (different validator-
+    set sizes per tenant): the tenant's small hot entry plus a big new
+    tensor that cannot fit even after evicting it."""
+    small = np.zeros((4, 20, 2), dtype=np.int16)   # 640 B
+    big = np.zeros((4, 20, 4), dtype=np.int16)     # 1280 B
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=int(2.5 * big.nbytes), enabled=True,  # 3200 B
+        tenant_quota_bytes=2 * big.nbytes)
+    dx1 = devcache.keyset_digest(b"x1" + b"\0" * 30)
+    dx2 = devcache.keyset_digest(b"x2" + b"\0" * 30)
+    dy1 = devcache.keyset_digest(b"y1" + b"\0" * 30)
+    dy2 = devcache.keyset_digest(b"y2" + b"\0" * 30)
+    for d in (dx1, dx2):
+        cache.assign_tenant(d, "X")
+        assert cache.build(d, 1, big) is not None   # X holds 2560 B
+    cache.assign_tenant(dy1, "Y")
+    assert cache.build(dy1, 1, small) is not None   # total 3200 = budget
+    # Y's big keyset cannot fit even after evicting Y's own small
+    # entry (X's 2560 + 1280 > 3200): refuse, and dy1 must survive.
+    cache.assign_tenant(dy2, "Y")
+    assert cache.build(dy2, 1, big) is None
+    assert cache.lookup(dy1) is not None, (
+        "refusal destroyed the tenant's own hot entry")
+    assert cache.tenant_stats()["Y"]["quota_rejected"] == 1
+    assert cache.tenant_stats()["Y"]["evictions"] == 0
+    # X untouched throughout
+    assert cache.lookup(dx1) is not None and cache.lookup(dx2) is not None
+
+
+def test_class_policy_resume_required_when_shedding():
+    with pytest.raises(ValueError, match="disarm"):
+        tenancy.ClassPolicy("rpc", 0.5, None)
+
+
+def test_zero_quota_keeps_shared_lru_pool():
+    """tenant_quota_bytes=0 is the pre-tenancy shared pool: eviction
+    crosses tenants by global LRU exactly as before."""
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=2 * head.nbytes, enabled=True,
+        tenant_quota_bytes=0)
+    da = devcache.keyset_digest(b"a" * 32)
+    db = devcache.keyset_digest(b"b" * 32)
+    dc = devcache.keyset_digest(b"c" * 32)
+    cache.assign_tenant(da, "A")
+    cache.assign_tenant(db, "B")
+    cache.assign_tenant(dc, "A")
+    cache.build(da, 1, head)
+    cache.build(db, 1, head)
+    cache.build(dc, 1, head)  # evicts global LRU = A's first entry
+    assert cache.lookup(da) is None
+    assert cache.lookup(db) is not None
+
+
+# -- per-tenant rotation ---------------------------------------------------
+
+def test_rotate_tenant_stales_exactly_that_tenant():
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=4 * head.nbytes, enabled=True,
+        tenant_quota_bytes=2 * head.nbytes)
+    da = devcache.keyset_digest(b"a" * 32)
+    db = devcache.keyset_digest(b"b" * 32)
+    cache.assign_tenant(da, "A")
+    cache.assign_tenant(db, "B")
+    cache.build(da, 1, head)
+    cache.build(db, 1, head)
+    assert cache.rotate_tenant("A") == 1
+    assert cache.lookup(da) is None          # stale tenant epoch
+    assert cache.lookup(db) is not None      # B untouched
+    assert cache.tenant_stats()["A"]["stale_epoch"] == 1
+    assert cache.tenant_stats()["A"]["rotations"] == 1
+    assert cache.tenant_stats()["B"]["stale_epoch"] == 0
+    # a rebuild under the new epoch is hot again
+    cache.build(da, 1, head)
+    assert cache.lookup(da) is not None
+    # probe() agrees with lookup on tenant staleness
+    cache.rotate_tenant("A")
+    assert cache.probe(da)["hit"] is False
+    assert cache.probe(db)["hit"] is True
+
+
+def test_global_bump_epoch_still_invalidates_every_tenant():
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=4 * head.nbytes, enabled=True)
+    da = devcache.keyset_digest(b"a" * 32)
+    db = devcache.keyset_digest(b"b" * 32)
+    cache.assign_tenant(da, "A")
+    cache.assign_tenant(db, "B")
+    cache.build(da, 1, head)
+    cache.build(db, 1, head)
+    cache.bump_epoch("out-of-band invalidation")
+    assert cache.lookup(da) is None and cache.lookup(db) is None
+
+
+# -- mid-wave rotation: verdict bit-identity (the acceptance gate) ---------
+
+def _warm_two_tenants(cache, mesh=0):
+    """Make both tenants' keysets resident (two sights each) under a
+    two-entry-equivalent per-tenant quota.  Waves are keyset-UNIFORM
+    per tenant — the workload shape the cache targets (a mixed-keyset
+    chunk always stages cold and never enters the cache at all)."""
+    cache.assign_tenant(digest_of(_KEYS_A), "chain-a")
+    cache.assign_tenant(digest_of(_KEYS_B), "chain-b")
+    for rep in range(2):
+        assert run_forced_device(
+            [tenant_verifier(_KEYS_A, b"warmA%d" % rep),
+             tenant_verifier(_KEYS_A, b"warmA%d-2" % rep)],
+            mesh=mesh) == [True, True]
+        assert run_forced_device(
+            [tenant_verifier(_KEYS_B, b"warmB%d" % rep),
+             tenant_verifier(_KEYS_B, b"warmB%d-2" % rep)],
+            mesh=mesh) == [True, True]
+
+
+def _rotation_storm(cache, mesh):
+    """Drive both tenants' recurring streams (alternating
+    keyset-uniform waves) while a RotateTenant fault window lands
+    mid-wave on the lookup stream; every rep's forced-device verdicts
+    must equal the host oracle, and chain-b's residency must never
+    stale or evict."""
+    _warm_two_tenants(cache, mesh=mesh)
+    plan = faults.devcache_plan(seed=0x407, kind="rotate", at=1,
+                                length=2, tenant="chain-a")
+    with faults.injected(plan):
+        for rep in range(4):
+            bad = rep == 2
+            for keys, tag, want in ((_KEYS_A, b"f", not bad),
+                                    (_KEYS_B, b"g", True)):
+                vs = [tenant_verifier(keys, b"%s%d" % (tag, rep),
+                                      bad=bad and keys is _KEYS_A)]
+                hv = host_verdicts(
+                    [tenant_verifier(keys, b"%s%d" % (tag, rep),
+                                     bad=bad and keys is _KEYS_A)])
+                assert run_forced_device(vs, mesh=mesh) == hv == [want]
+    assert plan.calls_seen(faults.SITE_DEVCACHE) >= 3
+    ts = cache.tenant_stats()
+    assert ts["chain-a"]["rotations"] >= 1
+    assert ts["chain-a"]["stale_epoch"] >= 1
+    # isolation: the rotation storm must not have staled or evicted B
+    assert ts["chain-b"]["stale_epoch"] == 0
+    assert ts["chain-b"]["evictions"] == 0
+    assert ts["chain-b"]["resident_keysets"] == 1
+    assert ts["chain-b"]["hits"] >= 1
+
+
+def test_midwave_rotation_verdicts_host_identical_single_device(
+        reset_state):
+    from ed25519_consensus_tpu.ops import limbs
+
+    entry_bytes = 4 * limbs.NLIMBS * 2 * (len(_KEYS_A) + 1) * 2
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=int(2.5 * entry_bytes), enabled=True,
+        tenant_quota_bytes=int(1.2 * entry_bytes))
+    devcache.set_default_cache(cache)
+    _rotation_storm(cache, mesh=0)
+
+
+def test_midwave_rotation_verdicts_host_identical_mesh(reset_state):
+    _require_devices(8)
+    from ed25519_consensus_tpu.ops import limbs
+
+    entry_bytes = 4 * limbs.NLIMBS * 2 * (len(_KEYS_A) + 1) * 2
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=int(2.5 * entry_bytes), enabled=True,
+        tenant_quota_bytes=int(1.2 * entry_bytes))
+    devcache.set_default_cache(cache)
+    _rotation_storm(cache, mesh=8)
+
+
+def test_small_order_matrix_through_rotating_tenant(reset_state):
+    """The conformance-matrix subset AS a rotating tenant's keyset,
+    under a two-entry budget: rotation → cold restage → rebuild, with
+    every rep's forced-device verdicts identical to the host oracle
+    (all-valid under ZIP215)."""
+    cache = reset_state
+    mv = matrix_verifier()
+    d = devcache.keyset_digest(mv._canonical_keyset_blob())
+    cache.assign_tenant(d, "chain-matrix")
+    hv = host_verdicts([matrix_verifier()])
+    assert hv == [True]
+    for rep in range(3):  # cold, build, hit
+        assert run_forced_device([matrix_verifier()]) == hv
+    assert cache.tenant_stats()["chain-matrix"]["hits"] >= 1
+    cache.rotate_tenant("chain-matrix")
+    # stale → restage (verdicts hold) → resident again under new epoch
+    assert run_forced_device([matrix_verifier()]) == hv
+    assert cache.tenant_stats()["chain-matrix"]["stale_epoch"] >= 1
+    assert run_forced_device([matrix_verifier()]) == hv
+    ts = cache.tenant_stats()["chain-matrix"]
+    assert ts["resident_keysets"] == 1 and ts["epoch"] == 1
+
+
+def test_keyset_rotation_changes_content_address():
+    """An actual validator-set change is a new canonical blob — a new
+    content address — so the rotated tenant's first post-rotation
+    dispatch can never alias the stale entry even without the epoch
+    machinery (defense in depth)."""
+    assert digest_of(_KEYS_A) != digest_of(_KEYS_A2)
+
+
+def test_lane_death_still_drops_every_tenant(reset_state):
+    """Lane death is a DEVICE event, not a tenant event: all residency
+    drops (the replacement lane owes nothing to the old one),
+    whatever partition entries lived in."""
+    cache = reset_state
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    for name, tag in ((b"a", "A"), (b"b", "B")):
+        d = devcache.keyset_digest(name * 32)
+        cache.assign_tenant(d, tag)
+        cache.should_build(d)
+        cache.build(d, 1, head)
+    assert cache.resident_count() == 2
+    h = health.DeviceHealth(clock=health.FakeClock())
+    h.mark_lane_stuck()
+    assert cache.resident_count() == 0

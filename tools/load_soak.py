@@ -9,6 +9,13 @@ asserting, for every round, the acceptance bar of the service layer:
   bit-identical to the pure-host verdict of the same batch, whatever
   the (injected) device did and however the breaker/queue behaved.
 
+Submissions carry a seeded MIX of traffic classes (consensus/mempool/
+rpc, tenancy.py) since the multi-tenant round, so the per-class
+admission queues and priority drain are under the same storms; the
+per-round record carries the class tallies.  Open-loop SLO measurement
+(latency percentiles, per-class shed rates) is tools/traffic_lab.py's
+job, not this soak's.
+
 Storm profiles (--storm; faults.storm_plan + request-side schedules):
 
 * ``none``     — pure overload: no device faults, capacity pressure only.
@@ -56,7 +63,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from ed25519_consensus_tpu import (  # noqa: E402
-    SigningKey, batch, devcache, faults, service,
+    SigningKey, batch, devcache, faults, service, tenancy,
 )
 from ed25519_consensus_tpu.utils import metrics  # noqa: E402
 
@@ -106,6 +113,19 @@ def storm_for(profile, seed, site):
                                       stall_rate=0.1, stall_seconds=0.3,
                                       corrupt_rate=0.1, site=site)
     raise SystemExit(f"unknown storm profile {profile!r}")
+
+
+def class_for(rnd):
+    """Seeded traffic class per submission: the storm pressure lands on
+    a MIXED class population, so the per-class queues, priority drain,
+    and class-keyed watermarks are all under fire in every soak round
+    (consensus-heavy mix — the service's production shape)."""
+    r = rnd.random()
+    if r < 0.4:
+        return tenancy.CLASS_CONSENSUS
+    if r < 0.8:
+        return tenancy.CLASS_MEMPOOL
+    return tenancy.CLASS_RPC
 
 
 def deadline_for(profile, rnd):
@@ -167,6 +187,8 @@ def run_round(r, round_seed, args, keys, site):
     outcomes = [None] * len(vs)
     drnd = random.Random(round_seed ^ 0xDEAD)
     deadlines = [deadline_for(args.storm, drnd) for _ in vs]
+    crnd = random.Random(round_seed ^ 0xC1A5)
+    classes = [class_for(crnd) for _ in vs]
 
     def submitter(k):
         # Submit the whole stream FIRST (queue pressure is the point of
@@ -180,7 +202,8 @@ def run_round(r, round_seed, args, keys, site):
             try:
                 t = svc.submit(
                     vs[idx],
-                    deadline=None if dl is None else svc.now() + dl)
+                    deadline=None if dl is None else svc.now() + dl,
+                    cls=classes[idx])
             except service.Overloaded:
                 outcomes[idx] = "overloaded"
                 continue
@@ -228,6 +251,7 @@ def run_round(r, round_seed, args, keys, site):
         "breaker": st["breaker_state"],
         "crash_fallbacks": st["crash_fallbacks"],
         "host_waves": st["host_waves"], "device_waves": st["device_waves"],
+        "by_class": st["by_class"],
         **tally,
     }
     ok = lost == 0 and not mismatches

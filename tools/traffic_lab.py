@@ -1,0 +1,492 @@
+"""Open-loop traffic lab: the service SLO proof at production shape
+(ROADMAP item 3; the load_soak storms are closed-loop and cannot
+measure latency under *arrival* pressure).
+
+Where tools/load_soak.py drives closed-loop storms (every submitter
+waits for its previous ticket), this lab replays an OPEN-LOOP arrival
+schedule — seeded Poisson / burst / diurnal processes over a mixed
+tenant-class matrix (tenancy.py) — against a `VerifyService` on an
+injected virtual clock, and reports the Service Level Objective
+surface as a first-class `service_slo` bench block:
+
+* p50/p99/p999/max verdict latency PER CLASS (virtual seconds),
+* shed rate per class (admission `Overloaded` + `DeadlineExceeded`),
+* breaker transition count,
+* per-tenant device-operand-cache hit rates (``--device`` runs), and
+* a replay digest: the whole run is a pure function of the seed.
+
+Time model (what makes an open-loop lab deterministic): arrivals,
+deadlines, admission decisions, and wave completions all live on an
+injected `health.FakeClock`.  Real verification still runs for every
+wave — verdicts are real, checked against the host oracle — but the
+VIRTUAL cost of a wave is `overhead + live_sigs / service_rate`, where
+`service_rate` is the measured capacity of this host (calibrated at
+startup with the pure-host verifier, or pinned with --service-rate for
+bit-reproducible runs).  Offered load is ``--load`` (default 0.8) of
+that capacity, so the CI gate literally reads "p99 under deadline at
+80% of measured capacity".
+
+Scale-free units: the queue capacity is sized as a fraction of the
+run's volume, and matrix deadlines are interpreted in CAPACITY-DRAIN
+units (T_cap = capacity_sigs / service_rate seconds) — the same
+scenario exercises the same queueing dynamics on a laptop and a TPU
+host.
+
+Gates (exit nonzero on violation):
+
+* nothing lost — every request resolves to a verdict or an explicit
+  Overloaded / DeadlineExceeded;
+* verdicts host-identical (the oracle is computed per batch at
+  construction);
+* consensus-class shed rate is ZERO (never watermark-shed, never
+  deadline-shed) while rpc-class traffic IS being shed
+  (--require-rpc-shed, on in the default overload scenario);
+* consensus-class p99 latency under the consensus deadline.
+
+Usage:
+  python tools/traffic_lab.py [--seed N] [--requests 800] [--load 0.8]
+      [--service-rate SIGS_PER_S] [--capacity-frac 0.05]
+      [--device] [--rotate-every-frac 0.25] [--rotation-faults]
+      [--json]
+"""
+
+import argparse
+import functools
+import hashlib
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ed25519_consensus_tpu import (  # noqa: E402
+    SigningKey, batch, config, devcache, faults, health, service, tenancy,
+)
+from ed25519_consensus_tpu.utils import metrics  # noqa: E402
+
+
+# One mixing construction per process: replay digests from the lab and
+# schedules from the library must never silently diverge.
+_stable_seed = tenancy._stable_seed
+
+
+def calibrate_service_rate(seed: int, sigs: int = 4,
+                           batches: int = 32) -> float:
+    """Measured pure-host verification capacity (signatures/second) of
+    THIS host — the denominator of the 80%-of-capacity claim.  Uses
+    time.perf_counter (metrics timing, not scheduler time — the
+    injected-clock rule CL002 covers scheduler/service timestamps)."""
+    rnd = random.Random(_stable_seed(seed, "calibrate"))
+    keys = [SigningKey.new(rnd) for _ in range(sigs)]
+    vs = []
+    for b in range(batches):
+        v = batch.Verifier()
+        for j, sk in enumerate(keys):
+            m = b"calibrate %d %d" % (b, j)
+            v.queue((sk.verification_key_bytes(), sk.sign(m), m))
+        vs.append(v)
+    rng = random.Random(_stable_seed(seed, "calibrate-rng"))
+    t0 = time.perf_counter()
+    for v in vs:
+        batch._host_verdict(v, rng)
+    dt = max(time.perf_counter() - t0, 1e-6)
+    return (batches * sigs) / dt
+
+
+@functools.lru_cache(maxsize=256)
+def tenant_keyset(seed: int, tenant: str, generation: int,
+                  sigs: int) -> "tuple":
+    """The validator keyset of `tenant` at rotation `generation` —
+    fresh deterministic keys per (tenant, generation), so an epoch
+    rotation really is a disjoint keyset (new content address, full
+    devcache churn).  Memoized: key generation is scalar-mult-priced
+    and every request of a generation shares one keyset."""
+    rnd = random.Random(_stable_seed(seed, "keys", tenant, generation))
+    return tuple(SigningKey.new(rnd) for _ in range(sigs))
+
+
+class LabRequest:
+    """One submitted batch and its full open-loop accounting."""
+
+    __slots__ = ("stream_idx", "seq", "arrival", "cls", "tenant",
+                 "sigs", "want", "verifier", "ticket", "kind",
+                 "verdict", "done_at", "deadline")
+
+    def __init__(self, stream_idx, seq, arrival, cls, tenant, sigs,
+                 want, verifier, deadline):
+        self.stream_idx = stream_idx
+        self.seq = seq
+        self.arrival = arrival
+        self.cls = cls
+        self.tenant = tenant
+        self.sigs = sigs
+        self.want = want
+        self.verifier = verifier
+        self.deadline = deadline
+        self.ticket = None
+        self.kind = None       # "verdict" | "overloaded" | "shed_deadline"
+        self.verdict = None
+        self.done_at = None
+
+
+def build_schedule(matrix, seed, requests_target, load, rate):
+    """The full arrival schedule: [(t, stream_idx, seq)] sorted by
+    (t, stream_idx, seq) — a pure function of (matrix, seed,
+    requests_target, load, rate-derived horizon)."""
+    mean_sigs = sum(s.fraction * s.sigs for s in matrix) / sum(
+        s.fraction for s in matrix)
+    horizon = requests_target * mean_sigs / (load * rate)
+    events = []
+    for si, stream in enumerate(matrix):
+        lam = load * rate * stream.fraction / stream.sigs  # batches/s
+        kw = dict(stream.kind_kw)
+        # Periodic structure scales with the horizon so the same
+        # scenario shape replays at any calibrated rate.
+        if stream.kind == "burst":
+            kw.setdefault("burst_every", horizon / 3.0)
+            kw.setdefault("burst_len", horizon / 12.0)
+            kw.setdefault("burst_factor", 4.0)
+        elif stream.kind == "diurnal":
+            kw.setdefault("period", horizon / 2.0)
+            kw.setdefault("amplitude", 0.5)
+        times = tenancy.arrivals(stream.kind, lam, horizon,
+                                 seed=_stable_seed(seed, "arrivals", si),
+                                 **kw)
+        events.extend((t, si, k) for k, t in enumerate(times))
+    events.sort()
+    return events, horizon
+
+
+def build_request(matrix, seed, si, seq, t, rotate_every,
+                  deadline_scale, clock_start):
+    """Construct the batch for one arrival: keyset of the stream's
+    tenant at the CURRENT rotation generation, seeded tampering, host
+    oracle truth by construction."""
+    stream = matrix[si]
+    gen = int(t // rotate_every) if rotate_every else 0
+    keys = tenant_keyset(seed, stream.tenant, gen, stream.sigs)
+    rnd = random.Random(_stable_seed(seed, "batch", si, seq))
+    bad_at = (rnd.randrange(stream.sigs)
+              if rnd.random() < stream.bad_rate else -1)
+    v = batch.Verifier()
+    for j, sk in enumerate(keys):
+        m = b"lab %d %d %d" % (si, seq, j)
+        sig = sk.sign(m)
+        if j == bad_at:
+            m += b"!"
+        v.queue((sk.verification_key_bytes(), sig, m))
+    deadline = (None if stream.deadline_s is None
+                else clock_start + t + stream.deadline_s * deadline_scale)
+    return LabRequest(si, seq, t, stream.cls, stream.tenant,
+                      stream.sigs, bad_at < 0, v, deadline), gen
+
+
+def run_lab(cfg) -> dict:
+    """One full open-loop run; returns the service_slo summary dict
+    (cfg is the argparse namespace — tests build it directly)."""
+    matrix = tenancy.default_matrix()
+    rate = cfg.service_rate or calibrate_service_rate(cfg.seed)
+    schedule, horizon = build_schedule(matrix, cfg.seed, cfg.requests,
+                                       cfg.load, rate)
+    mean_sigs = sum(s.fraction * s.sigs for s in matrix) / sum(
+        s.fraction for s in matrix)
+    capacity_sigs = max(48, int(cfg.capacity_frac * cfg.requests
+                                * mean_sigs))
+    t_cap = capacity_sigs / rate  # the deadline unit (module docstring)
+    rotate_every = (horizon * cfg.rotate_every_frac
+                    if cfg.rotate_every_frac else 0.0)
+
+    clock = health.FakeClock()
+    t0 = clock.monotonic()
+    tenants = sorted({s.tenant for s in matrix})
+    entry_bytes = None
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=1 << 26, enabled=bool(cfg.device),
+        tenant_quota_bytes=0)
+    if cfg.device:
+        from ed25519_consensus_tpu.ops import limbs
+
+        # Budget ~2.5 entries with a ~1.2-entry per-tenant quota: both
+        # tenants can hold exactly one hot keyset, rotation churn must
+        # evict-and-rebuild strictly inside the rotating tenant's
+        # partition.
+        entry_bytes = 4 * limbs.NLIMBS * 2 * (matrix[0].sigs + 1) * 2
+        cache = devcache.DeviceOperandCache(
+            budget_bytes=int(2.5 * entry_bytes), enabled=True,
+            tenant_quota_bytes=int(1.2 * entry_bytes))
+    devcache.set_default_cache(cache)
+
+    svc = service.VerifyService(
+        capacity_sigs=capacity_sigs,
+        wave_max_batches=cfg.wave_max_batches,
+        # chunk=1 in device mode: a wave mixes tenants, and only a
+        # keyset-UNIFORM chunk can serve from (or build) devcache
+        # residency — one batch per chunk keeps every chunk uniform.
+        chunk=1 if cfg.device else 8,
+        hybrid=False if cfg.device else True,
+        merge="never" if cfg.device else "auto",
+        mesh=0,
+        health=None if cfg.device else service._HostOnlyHealth(clock),
+        clock=clock, rng=random.Random(_stable_seed(cfg.seed, "rng")),
+        auto_start=False)
+
+    plan = None
+    if cfg.rotation_faults and cfg.device:
+        # A rotation fault window riding the lookup stream: tenant[0]'s
+        # keyset rotates mid-wave, between staging and dispatch.
+        plan = faults.devcache_plan(cfg.seed, "rotate", at=3, length=3,
+                                    tenant=tenants[0])
+        faults.install(plan)
+
+    requests, pending = [], []
+    last_gen = {}
+    busy_until = [None]
+
+    def submit_one(t, si, seq):
+        req, gen = build_request(matrix, cfg.seed, si, seq, t,
+                                 rotate_every, t_cap, t0)
+        requests.append(req)
+        if rotate_every and last_gen.get(req.tenant, 0) != gen:
+            # Epoch boundary: the tenant's validator set rotated.
+            last_gen[req.tenant] = gen
+            cache.rotate_tenant(req.tenant, "epoch boundary")
+        last_gen.setdefault(req.tenant, gen)
+        try:
+            req.ticket = svc.submit(req.verifier, deadline=req.deadline,
+                                    cls=req.cls, tenant=req.tenant)
+            pending.append(req)
+        except service.Overloaded:
+            req.kind = "overloaded"
+            req.done_at = clock.monotonic()
+
+    def start_wave():
+        now = clock.monotonic()
+        inflight = [r for r in pending if not r.ticket.done()]
+        if svc.process_once(block=False) == 0:
+            busy_until[0] = None
+            return
+        live_sigs = 0
+        resolved = [r for r in inflight if r.ticket.done()]
+        for r in resolved:
+            try:
+                r.verdict = r.ticket.result(0)
+                r.kind = "verdict"
+                live_sigs += r.sigs
+            except service.DeadlineExceeded:
+                r.kind = "shed_deadline"
+                r.done_at = now
+        cost = (cfg.wave_overhead * t_cap + live_sigs / rate
+                if live_sigs else 0.0)
+        done_at = now + cost
+        for r in resolved:
+            if r.kind == "verdict":
+                r.done_at = done_at
+        busy_until[0] = done_at if live_sigs else None
+        for r in resolved:
+            pending.remove(r)
+
+    try:
+        i = 0
+        while i < len(schedule) or busy_until[0] is not None \
+                or svc.stats()["queue_requests"]:
+            t_arr = schedule[i][0] + t0 if i < len(schedule) else None
+            if busy_until[0] is not None and (t_arr is None
+                                              or busy_until[0] <= t_arr):
+                clock.advance_to(busy_until[0])
+                busy_until[0] = None
+                start_wave()
+            elif t_arr is not None:
+                clock.advance_to(t_arr)
+                submit_one(*schedule[i])
+                i += 1
+                if busy_until[0] is None:
+                    start_wave()
+            else:
+                start_wave()
+        svc.close()
+    finally:
+        # Never leak the installed fault plan or the tiny injected
+        # cache into later in-process work (the test suites call
+        # run_lab directly) — whatever happened above.
+        if plan is not None:
+            faults.uninstall()
+        devcache.set_default_cache(None)
+
+    return summarize(cfg, matrix, requests, svc, cache, rate,
+                     capacity_sigs, t_cap, horizon, t0)
+
+
+def summarize(cfg, matrix, requests, svc, cache, rate, capacity_sigs,
+              t_cap, horizon, t0) -> dict:
+    by_class = {}
+    for cls in tenancy.CLASSES:
+        rs = [r for r in requests if r.cls == cls]
+        lats = [r.done_at - (t0 + r.arrival) for r in rs
+                if r.kind == "verdict"]
+        pct = metrics.percentiles(lats)
+        shed = sum(1 for r in rs
+                   if r.kind in ("overloaded", "shed_deadline"))
+        deadlines = [s.deadline_s * t_cap for s in matrix
+                     if s.cls == cls and s.deadline_s is not None]
+        by_class[cls] = {
+            "requests": len(rs),
+            "verdicts": len(lats),
+            "overloaded": sum(1 for r in rs if r.kind == "overloaded"),
+            "shed_deadline": sum(1 for r in rs
+                                 if r.kind == "shed_deadline"),
+            "shed_rate": round(shed / len(rs), 4) if rs else 0.0,
+            "deadline_s": min(deadlines) if deadlines else None,
+            "latency_s": {
+                "p50": pct[0.5], "p99": pct[0.99], "p999": pct[0.999],
+                "max": max(lats) if lats else None,
+            },
+        }
+
+    lost = sum(1 for r in requests if r.kind is None)
+    mismatches = sum(1 for r in requests
+                     if r.kind == "verdict" and r.verdict != r.want)
+    digest = hashlib.sha256()
+    for r in requests:
+        digest.update(repr((r.stream_idx, r.seq, round(r.arrival, 9),
+                            r.kind, r.verdict,
+                            None if r.done_at is None
+                            else round(r.done_at - t0, 9))).encode())
+
+    cons = by_class[tenancy.CLASS_CONSENSUS]
+    gates = {
+        "zero_lost": lost == 0,
+        "host_identical_verdicts": mismatches == 0,
+        "consensus_shed_rate_zero": cons["shed_rate"] == 0.0,
+        "consensus_p99_under_deadline": (
+            cons["latency_s"]["p99"] is not None
+            and cons["deadline_s"] is not None
+            and cons["latency_s"]["p99"] < cons["deadline_s"]),
+    }
+    if cfg.require_rpc_shed:
+        gates["rpc_sheds_under_overload"] = (
+            by_class[tenancy.CLASS_RPC]["shed_rate"] > 0.0)
+
+    st = svc.stats()
+    summary = {
+        "ok": all(gates.values()),
+        "gates": gates,
+        "seed": cfg.seed,
+        "requests": len(requests),
+        "lost": lost,
+        "verdict_mismatches": mismatches,
+        "load": cfg.load,
+        "service_rate_sigs_per_s": round(rate, 1),
+        "calibrated": not cfg.service_rate,
+        "capacity_sigs": capacity_sigs,
+        "t_cap_s": t_cap,
+        "horizon_s": horizon,
+        "device": bool(cfg.device),
+        "rotation_faults": bool(cfg.rotation_faults and cfg.device),
+        "by_class": by_class,
+        "by_tenant_devcache": cache.tenant_stats() if cfg.device else {},
+        "devcache": cache.stats() if cfg.device else {},
+        "breaker_transitions": len(svc.breaker.transitions),
+        "breaker_state": st["breaker_state"],
+        "service_by_class": st["by_class"],
+        "waves": st["waves"],
+        "replay_digest": digest.hexdigest(),
+    }
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=lambda s: int(s, 0),
+                    default=config.get("ED25519_TPU_TRAFFIC_LAB_SEED"))
+    ap.add_argument("--requests", type=int, default=800,
+                    help="target total request count (horizon derives "
+                         "from it at the offered load)")
+    ap.add_argument("--load", type=float, default=0.8,
+                    help="offered load as a fraction of measured "
+                         "capacity (the SLO envelope point)")
+    ap.add_argument("--service-rate", type=float, default=0.0,
+                    help="pin the virtual cost model (sigs/s) instead "
+                         "of calibrating — makes the run bit-"
+                         "reproducible across hosts")
+    ap.add_argument("--capacity-frac", type=float, default=0.05,
+                    help="queue capacity as a fraction of total run "
+                         "volume")
+    ap.add_argument("--wave-max-batches", type=int, default=16)
+    ap.add_argument("--wave-overhead", type=float, default=0.02,
+                    help="per-wave fixed cost in T_cap units")
+    ap.add_argument("--device", action="store_true",
+                    help="device-participating waves (forced-device, "
+                         "single lane): exercises per-tenant devcache "
+                         "residency; CI runs this on the CPU backend")
+    ap.add_argument("--rotate-every-frac", type=float, default=0.25,
+                    help="tenant keyset rotation period as a fraction "
+                         "of the horizon (0 disables rotation)")
+    ap.add_argument("--rotation-faults", action="store_true",
+                    help="with --device: land a mid-wave rotation "
+                         "fault window on the devcache lookup stream")
+    ap.add_argument("--require-rpc-shed", dest="require_rpc_shed",
+                    action="store_true", default=True)
+    ap.add_argument("--no-require-rpc-shed", dest="require_rpc_shed",
+                    action="store_false")
+    ap.add_argument("--json", action="store_true")
+    cfg = ap.parse_args(argv)
+
+    if cfg.device:
+        from chaos_soak import warm_shapes  # same tools/ dir
+
+        keys = tenant_keyset(cfg.seed, "warm", 0,
+                             tenancy.default_matrix()[0].sigs)
+        v = batch.Verifier()
+        for j, sk in enumerate(keys):
+            m = b"warm %d" % j
+            v.queue((sk.verification_key_bytes(), sk.sign(m), m))
+        warm_shapes(v, chunk=1, mesh=0)
+
+    summary = run_lab(cfg)
+
+    if cfg.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    cons = summary["by_class"][tenancy.CLASS_CONSENSUS]
+    # The bench-harvest line (same shape as bench.py metric blocks):
+    # the headline is the consensus-class p99 at the SLO point.
+    print(json.dumps({
+        "metric": "service_slo",
+        "value": (round(cons["latency_s"]["p99"] * 1e3, 3)
+                  if cons["latency_s"]["p99"] is not None else None),
+        "unit": "ms_p99_consensus_verdict_latency",
+        "deadline_ms": (round(cons["deadline_s"] * 1e3, 3)
+                        if cons["deadline_s"] is not None else None),
+        "load": summary["load"],
+        "service_rate_sigs_per_s": summary["service_rate_sigs_per_s"],
+        "shed_rate_by_class": {
+            cls: summary["by_class"][cls]["shed_rate"]
+            for cls in tenancy.CLASSES},
+        "zero_lost": summary["gates"]["zero_lost"],
+        "host_identical": summary["gates"]["host_identical_verdicts"],
+        "breaker_transitions": summary["breaker_transitions"],
+        "devcache_hit_rate_by_tenant": {
+            t: ts.get("hit_rate")
+            for t, ts in summary["by_tenant_devcache"].items()},
+        "replay_digest": summary["replay_digest"],
+        "ok": summary["ok"],
+    }))
+    print("SERVICE_SLO", json.dumps(
+        {k: v for k, v in summary.items() if k != "by_class"}))
+    if not summary["ok"]:
+        failed = [g for g, ok in summary["gates"].items() if not ok]
+        print(f"VIOLATION: service_slo gates failed: {failed} "
+              f"(replay with --seed {summary['seed']:#x})",
+              file=sys.stderr)
+    sys.stdout.flush()
+    # Same teardown discipline as bench/load_soak: never let normal
+    # interpreter finalization run with a lane worker parked in the
+    # accelerator runtime.
+    batch._DeviceLane.reset_all(timeout=30.0)
+    os._exit(0 if summary["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
