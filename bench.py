@@ -798,11 +798,57 @@ def main():
             "wall_seconds": round(wall, 3),
             "shape": [chunk_b, int(pad)],
         }
+        # r2-vs-r5 reconciliation arithmetic (ISSUE 7 / VERDICT r5 #1):
+        # the r2 trace's "750k terms/s" was a SINGLE 4096-term block at
+        # B=1; the production shape runs B·N/4096 blocks per call plus
+        # an XLA fold whose cost scales with the block count, and the
+        # real/padded term ratio discounts the rate further.  The
+        # per-4096-block figure here is the shape-independent number to
+        # compare across rounds (full finding:
+        # docs/device-program-reconciliation.md).
+        blocks = chunk_b * pad / 4096.0
+        res["reconciliation"] = {
+            "blocks_per_call": round(blocks, 2),
+            "ms_per_4096_term_block": round(
+                total_us / 1e3 / calls / blocks, 2),
+            "padding_ratio": round(
+                staged.n_device_terms / float(pad), 4),
+            "doc": "docs/device-program-reconciliation.md",
+        }
         print(f"# [device-program] {res['program_ms_per_call']} ms/call "
               f"on-chip -> {res['terms_per_sec']:.0f} terms/s, "
               f"{res['sigs_equiv_per_sec']:.0f} sigs-equiv/s "
-              f"(wall {wall:.2f}s for {calls} calls)", file=sys.stderr)
+              f"(wall {wall:.2f}s for {calls} calls, "
+              f"{res['reconciliation']['ms_per_4096_term_block']} ms "
+              f"per 4096-term block)", file=sys.stderr)
         return res
+
+    def measure_device_profile(chunk_b: int = 8):
+        """The per-stage on-chip decomposition (ISSUE 7 profile
+        ledger): table-build vs window-select vs in-kernel fold vs XLA
+        fold, measured as differences between real kernel variants at
+        the production shape (tools/microbench_pallas.py
+        --profile-ledger).  Pallas-path only — the stage variants are
+        Mosaic kernels; on an XLA-kernel backend this records why it
+        was skipped instead."""
+        from ed25519_consensus_tpu.ops import msm as _msm
+
+        staged = rebuild_fresh(bv)._stage(rng)
+        pad = _msm.preferred_pad(staged.n_device_terms)
+        if not _msm._use_pallas() or pad % 4096:
+            return {"skipped": "profile ledger needs the Pallas kernel "
+                               "(TPU backend) and a 4096-multiple pad; "
+                               f"got pad={int(pad)}"}
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import microbench_pallas as _mb
+
+        box = []
+        res = _timed(lambda: box.append(_mb.profile_ledger(
+            chunk_b=chunk_b, n_lanes=int(pad))), 600)
+        if res is not None or not box:
+            return {"error": f"watchdog: {res}"[:120]}
+        return box[0]
 
     best = measure(backend, depth)
     stats = {}
@@ -819,6 +865,7 @@ def main():
     # round.
     device_only = None
     device_program = None
+    device_program_profile = None
     if backend == "device" and depth > 1:
         try:
             # 16 batches = two full pipelined chunks (forced-device mode
@@ -837,6 +884,14 @@ def main():
             device_program = measure_device_program()
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             device_program = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+        try:
+            # The stage DECOMPOSITION of the program time above (ISSUE 7
+            # profile ledger): where the ms/call goes — table build vs
+            # select vs fold vs the XLA cross-block fold.
+            device_program_profile = measure_device_profile()
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            device_program_profile = {
+                "error": f"{type(e).__name__}: {str(e)[:120]}"}
 
     if host_best is not None and host_best < best:
         # The right lane split depends on the node (host core count, link
@@ -880,6 +935,11 @@ def main():
             device_program.get("terms_per_sec")
             if isinstance(device_program, dict) else None),
         "device_program": device_program,
+        # Per-stage on-chip decomposition + the r2/r5 reconciliation
+        # inputs (ISSUE 7): table_build/select/fold/xla_fold ms buckets
+        # from tools/microbench_pallas.py --profile-ledger; the written
+        # finding lives in docs/device-program-reconciliation.md.
+        "device_program_profile": device_program_profile,
         "secondary_host_sigs_per_sec": secondary,
     }))
 

@@ -46,6 +46,20 @@ the XLA scan kernel and the sharded mesh kernel):
   (per-shard residency).  Its collective schedule is held to exactly
   ``['all_gather']``, same as the cold mesh path: residency must not
   change what crosses the ICI.
+
+Round-8 variants (ISSUE 7 — the ≥500k terms/s sweep; every candidate
+the kernel lab may select must already live inside the audited
+envelope):
+
+* ``xla-tables-ref``     — the resident-multiples-TABLES hot path
+  (devcache kind="tables"): on-device R-table build + tables-input
+  scan kernel (ops.msm.dispatch_window_sums_many_tables).
+* ``pallas-tables-ref``  — the Mosaic tables-input kernel variant
+  (stage-1 build skipped; one table shared across the batch axis).
+* ``pallas-radix32``     — signed radix-32: 27 five-bit digit planes
+  against the 17-entry [0..16]P table.
+* ``pallas-int16-fold``  — int16 fold accumulators (narrowed stores
+  between halving point-adds; exact by the U bound).
 """
 
 import json
@@ -232,12 +246,23 @@ def trace_variants(include_sharded: "bool | None" = None) -> dict:
 
     variants["xla-devcache-assemble"] = (
         _cached_dispatch, (_cdigits, _head, _rwire))
+    # The resident-TABLES hot path (round 8): on-device R-table build +
+    # the tables-input scan kernel, composed exactly as
+    # ops.msm.dispatch_window_sums_many_tables runs it.
+    variants["xla-tables-ref"] = (
+        msm._compiled_tables_dispatch.__wrapped__(
+            _B, _n_head, _n_r, NWINDOWS, dwire="packed"),
+        (_cdigits,
+         np.zeros((9, 4, NLIMBS, _n_head), dtype=np.int16),
+         _rwire))
     for name, kwargs in (
             ("pallas-rolled", dict(body="rolled", win_chunk=11)),
             ("pallas-hybrid", dict(body="hybrid", win_chunk=3)),
             ("pallas-tbl-int32", dict(body="rolled", tbl_dtype="int32",
                                       win_chunk=11)),
             ("pallas-win-chunk3", dict(body="rolled", win_chunk=3)),
+            ("pallas-int16-fold", dict(body="rolled", win_chunk=11,
+                                       fold_dtype="int16")),
     ):
         variants[name] = (
             pallas_msm._compiled_pipeline.__wrapped__(
@@ -245,6 +270,28 @@ def trace_variants(include_sharded: "bool | None" = None) -> dict:
                 wire="compressed", dwire="packed",
                 **kwargs),
             (digits, pts))
+    # Radix-32 (27 plain int8 planes — no packed wire at this radix).
+    from ..ops.limbs import NWINDOWS_R32
+
+    _dig32 = np.zeros((_B, NWINDOWS_R32, _N), dtype=np.int8)
+    variants["pallas-radix32"] = (
+        pallas_msm._compiled_pipeline.__wrapped__(
+            _B, _N, NWINDOWS_R32, interpret=True, tile=_TILE,
+            wire="compressed", dwire="plain", window_bits=5,
+            win_chunk=9, body="rolled"),
+        (_dig32, pts))
+    # The Mosaic tables-input kernel: full prebuilt tables, ONE table
+    # shared across the batch axis (tables_batch=1).
+    _dig_plain = np.zeros((_B, NWINDOWS, _N), dtype=np.int8)
+    _tbl_full = np.zeros((1, 9, 4, NLIMBS, _N), dtype=np.int16)
+    _tbl_full[:, :, 1, 0, :] = 1  # identity-ish rows: Y = Z = 1
+    _tbl_full[:, :, 2, 0, :] = 1
+    variants["pallas-tables-ref"] = (
+        pallas_msm._compiled_pipeline.__wrapped__(
+            _B, _N, NWINDOWS, interpret=True, tile=_TILE,
+            dwire="plain", tables_in=True, tables_batch=1,
+            body="rolled", win_chunk=11),
+        (_dig_plain, _tbl_full))
     if include_sharded is None:
         include_sharded = jax.device_count() >= 2
     if include_sharded:

@@ -445,6 +445,28 @@ class StagedBatch:
         return np.ascontiguousarray(
             np.concatenate([coeff_pts, shift_pts], axis=-1))
 
+    def head_tables_tensor(self) -> "np.ndarray":
+        """The keyset head MULTIPLES-TABLES tensor,
+        (9, 4, NLIMBS, 2·n_coeff) int16: for every head column P of
+        `head_tensor`, the exact [0..8]P table the kernel's stage 1
+        would otherwise rebuild on every call — what the round-8
+        devcache kind="tables" entry pins (hash over these exact
+        bytes) and keeps resident.  Built in exact host Point
+        arithmetic from the same column order as `head_tensor`, so the
+        two kinds always describe the same keyset; canonical mod-p
+        limbs fit int16 (13-bit limbs)."""
+        from .ops import limbs
+        from .ops.edwards import Point
+
+        head = self.head_tensor()
+        pts = [limbs.unpack_point(head[..., j])
+               for j in range(head.shape[-1])]
+        rows = [[Point(0, 1, 1, 0)] * len(pts), pts]
+        for _ in range(7):
+            rows.append([a.add(b) for a, b in zip(rows[-1], pts)])
+        return np.ascontiguousarray(np.stack(
+            [limbs.pack_point_batch(r).astype(np.int16) for r in rows]))
+
     def device_operands_cached(self, pad_fn):
         """Cache-aware device operands for a RESIDENT keyset: the
         digit planes for ALL lanes (the always-split head layout —
@@ -1324,18 +1346,21 @@ class _DeviceLane:
     def healthy(self) -> bool:
         return self._thread.is_alive() and not self._abandoned
 
-    def submit(self, digits, pts, cached=None) -> int:
+    def submit(self, digits, pts, cached=None, tables=None) -> int:
         """Queue one chunk dispatch.  Cold path: `digits`/`pts` are the
         full staged operands.  Cached path (`cached` = the looked-up
         devcache ResidentKeyset): `pts` is the per-signature R wire and
         `digits` is either the full-lane digit planes (single device)
         or a `(head_digits, r_digits)` pair (mesh lane) — the resident
         head tensor itself never rides the queue; the worker fetches
-        the committed device array from the entry."""
+        the committed device array from the entry.  `tables` (the
+        looked-up kind="tables" entry, single-device only) upgrades the
+        cached dispatch to the tables-resident kernel, which skips
+        in-kernel table construction for the head lanes."""
         with self._cv:
             cid = self._next_id
             self._next_id += 1
-        self._q.put((cid, digits, pts, cached))
+        self._q.put((cid, digits, pts, cached, tables))
         return cid
 
     def discard(self, cid: int) -> None:
@@ -1403,7 +1428,7 @@ class _DeviceLane:
             item = self._q.get()
             if item is None:
                 return
-            cid, digits, pts, cached = item
+            cid, digits, pts, cached, tables = item
             with self._cv:
                 if cid in self._discarded:
                     # caller already decided on the host (e.g. a leftover
@@ -1432,6 +1457,19 @@ class _DeviceLane:
                                 sh.sharded_window_sums_many_cached(
                                     dh, dr, head, pts, self._mesh,
                                     clock=clock))
+                    elif cached is not None and tables is not None:
+                        # Resident-TABLES dispatch (round 8): the head
+                        # lanes' multiples tables come from the entry's
+                        # committed device array; the kernel builds
+                        # tables only for the per-signature R lanes.
+                        lanes_key = digits.shape[2]
+                        n_batches = digits.shape[0]
+
+                        def _call():
+                            tbl = tables.device_ref(0)
+                            return np.asarray(
+                                _msm.dispatch_window_sums_many_tables(
+                                    digits, tbl, pts))
                     elif cached is not None:
                         lanes_key = digits.shape[2]
                         n_batches = digits.shape[0]
@@ -1465,12 +1503,14 @@ class _DeviceLane:
                         _faults.SITE_LANE, _call, mesh=self._mesh,
                         clock=clock))
                 # Fetch done ⇒ any first-compile for this shape is over:
-                # subsequent calls are held to the normal deadline.  The
-                # cached dispatch is a DIFFERENT executable at the same
-                # lane count, so it completes its own shape key.
-                _msm.mark_shape_completed(n_batches, lanes_key,
-                                          self._mesh,
-                                          cached=cached is not None)
+                # subsequent calls are held to the normal deadline.  Each
+                # cached dispatch form is a DIFFERENT executable at the
+                # same lane count, so each completes its own shape key
+                # (0 cold, 1 resident-head, 2 resident-tables).
+                _msm.mark_shape_completed(
+                    n_batches, lanes_key, self._mesh,
+                    cached=0 if cached is None else (
+                        2 if tables is not None else 1))
             except _faults.LaneDeathSignal:
                 # Injected mid-flight thread death: exit WITHOUT reporting
                 # a result or clearing _started — callers see an in-flight
@@ -1834,8 +1874,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         pol = policy if policy is not None else _routing.default_policy()
         est = (max(_routing.estimate_device_terms(v)
                    for v in verifiers) if verifiers else 0)
-        mesh = pol.choose_mesh(est, health=health,
-                               devcache_hot=devcache_probe["hit"])
+        mesh = pol.choose_mesh(
+            est, health=health, devcache_hot=devcache_probe["hit"],
+            tables_hot=devcache_probe.get("tables_hit", False))
     # mesh <= 1 is single-device dispatch: normalize EARLY so the lane,
     # the health object, the shard padding, and the shape-completed
     # grace keys all agree across call sites.
@@ -1866,9 +1907,11 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         "device_rejects_overturned": 0,
         # The cache-temperature input the routing decision consumed
         # (and the residency level at call entry), plus the number of
-        # chunk dispatches this call actually served from residency —
+        # chunk dispatches this call actually served from residency
+        # (head entries, and — round 8 — resident-tables upgrades) —
         # see devcache.py.
-        "devcache": dict(devcache_probe, dispatch_hits=0),
+        "devcache": dict(devcache_probe, dispatch_hits=0,
+                         table_dispatch_hits=0),
         "seconds": 0.0,
     }
 
@@ -1928,29 +1971,58 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             _host_times.append(now() - t0)
 
     def resident_entry_for(staged):
-        """The devcache entry covering EVERY staged batch of a chunk,
-        or None (mixed keysets, first sight, cache off, stale/corrupt —
-        all of which mean cold staging).  Chunks are keyset-uniform in
-        the workloads the cache targets (one validator set per stream);
-        a mixed chunk simply stages cold."""
+        """(head entry, tables entry) covering EVERY staged batch of a
+        chunk — each None when missing (mixed keysets, first sight,
+        cache off, stale/corrupt — all of which mean the next-colder
+        path: tables miss → head-resident dispatch, head miss → cold
+        staging).  Chunks are keyset-uniform in the workloads the
+        cache targets (one validator set per stream); a mixed chunk
+        simply stages cold."""
         if not devcache_cache.enabled:
-            return None
+            return None, None
         blobs = {s.keyset_blob for s in staged}
         if len(blobs) != 1 or None in blobs:
-            return None
+            return None, None
         if any(s.enc32 is None or s.hints is None for s in staged):
-            return None  # no compressed wire captured: cold path only
+            return None, None  # no compressed wire: cold path only
         digest = _devcache.keyset_digest(staged[0].keyset_blob)
         entry = devcache_cache.lookup(digest)
+        tables_on = _config.get("ED25519_TPU_DEVCACHE_TABLES")
+        tables = (devcache_cache.lookup(
+            digest, kind=_devcache.KIND_TABLES)
+            if tables_on and entry is not None else None)
         if entry is None and devcache_cache.should_build(digest):
             # Install residency for the NEXT dispatch; THIS chunk still
             # stages cold.  A miss — first sight, eviction, stale
             # epoch, hash mismatch — is therefore ALWAYS the cold path
             # (failure-model.md, cache rung 3), and a rebuilt entry
             # first serves only through a later hit's hash re-check.
-            devcache_cache.build(digest, len(staged[0].coeffs) - 1,
-                                 staged[0].head_tensor())
-        return entry
+            n_keys = len(staged[0].coeffs) - 1
+            head = staged[0].head_tensor()
+            devcache_cache.build(digest, n_keys, head)
+            if tables_on and devcache_cache.can_admit_tables(
+                    digest, 9 * head.nbytes):
+                # Tables ride the same second-sight moment: 9× the
+                # head bytes, host-built exact multiples.  The
+                # can_admit_tables pre-check (head+tables co-residency,
+                # quota, budget net of other tenants) keeps a cache
+                # certain to refuse — or to self-evict the head — from
+                # charging the staging path a host table build per
+                # chunk.
+                devcache_cache.build(
+                    digest, n_keys, staged[0].head_tables_tensor(),
+                    kind=_devcache.KIND_TABLES)
+        elif (entry is not None and tables is None and tables_on
+              and devcache_cache.can_admit_tables(
+                  digest, 9 * entry.head_tensor.nbytes)):
+            # Head resident but tables not (evicted / staled / built
+            # before round 8): rebuild the tables entry for the NEXT
+            # dispatch from the hash-verified staged bytes; this chunk
+            # runs the head-resident dispatch.
+            devcache_cache.build(
+                digest, entry.n_keys, staged[0].head_tables_tensor(),
+                kind=_devcache.KIND_TABLES)
+        return entry, tables
 
     def stage_chunk(vs_idx):
         staged, idxs = [], []
@@ -1961,9 +2033,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 idxs.append(i)
         if not staged:
             return None
-        entry = resident_entry_for(staged)
+        entry, tables_entry = resident_entry_for(staged)
         if entry is not None:
-            return stage_chunk_cached(staged, idxs, entry)
+            return stage_chunk_cached(staged, idxs, entry, tables_entry)
         if mesh and mesh > 1:
             from .parallel.sharded_msm import shard_pad
 
@@ -1997,9 +2069,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             pts = np.concatenate(
                 [pts, np.stack([ident] * nb).astype(pts.dtype)]
             )
-        return idxs, digits, pts, None
+        return idxs, digits, pts, None, None
 
-    def stage_chunk_cached(staged, idxs, entry):
+    def stage_chunk_cached(staged, idxs, entry, tables_entry=None):
         """Operand build for a RESIDENT keyset chunk: the head point
         bytes stay on the device (the entry's committed array); the
         wire carries only the full-lane digit planes (~17 B/term) and
@@ -2035,14 +2107,16 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if mesh and mesh > 1:
             # Mesh layout: head digits land on shard 0's head lanes
             # only (zero elsewhere — identity contributions), R digits
-            # shard over the term axis like the cold path.
+            # shard over the term axis like the cold path.  The
+            # tables-resident dispatch is single-device only (round 8;
+            # the sharded path keeps the head-resident form).
             dh = np.zeros(
                 (digits.shape[0], digits.shape[1], mesh * n_head),
                 dtype=digits.dtype)
             dh[:, :, :n_head] = digits[:, :, :n_head]
             dr = np.ascontiguousarray(digits[:, :, n_head:])
-            return idxs, (dh, dr), rwire, entry
-        return idxs, digits, rwire, entry
+            return idxs, (dh, dr), rwire, entry, None
+        return idxs, digits, rwire, entry, tables_entry
 
     # Work-stealing pipeline.  The device lane is ONE worker thread that
     # serializes every device-side call (launch + blocking fetch — both
@@ -2095,10 +2169,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         pending = stage_chunk(ch)
         if pending is None:
             return
-        idxs, digits, pts, cached = pending
-        cid = dev.submit(digits, pts, cached=cached)
+        idxs, digits, pts, cached, tables = pending
+        cid = dev.submit(digits, pts, cached=cached, tables=tables)
         if cached is not None:
             stats["devcache"]["dispatch_hits"] += 1
+        if tables is not None:
+            stats["devcache"]["table_dispatch_hits"] += 1
         # The padded shape key must match what the lane worker
         # completes — mesh-cached digits ride as a (head, R) pair:
         if isinstance(digits, tuple):
@@ -2107,10 +2183,13 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         else:
             padded_b, n_lanes = digits.shape[0], digits.shape[2]
         # (chunk id, real batch idxs, submit time, padded shape (B, N),
-        #  cached? — the cached dispatch is a different executable at
-        #  the same lane count, so it carries its own compile grace)
+        #  dispatch variant — each cached dispatch form is a different
+        #  executable at the same lane count, so each carries its own
+        #  compile grace: 0 cold, 1 resident-head, 2 resident-tables)
+        variant = 0 if cached is None else (2 if tables is not None
+                                            else 1)
         outstanding.append((cid, idxs, now(), padded_b, n_lanes,
-                            cached is not None))
+                            variant))
 
     def poll(block: bool):
         """Apply finished chunk results; returns True if progress.  On a
@@ -2387,6 +2466,14 @@ def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
             rr = np.stack([rw] * chunk)
             np.asarray(msm.dispatch_window_sums_many_cached(ddc, head, rr))
             msm.mark_shape_completed(chunk, ddc.shape[2], cached=True)
+            if _config.get("ED25519_TPU_DEVCACHE_TABLES"):
+                # ...and the resident-TABLES executable (round 8): yet
+                # another executable at the same lane count, the one a
+                # tables-resident recurring keyset dispatches through.
+                tbl = staged.head_tables_tensor()
+                np.asarray(msm.dispatch_window_sums_many_tables(
+                    ddc, tbl, rr))
+                msm.mark_shape_completed(chunk, ddc.shape[2], cached=2)
     except Exception:
         return  # same contract: cached warming is optional
 

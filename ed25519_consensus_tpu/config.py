@@ -167,6 +167,21 @@ KNOBS: "dict[str, Knob]" = dict([
        "Factor applied to the N* crossover model's fixed cost `a` "
        "when the dispatched keyset is device-resident (a hot keyset "
        "lowers the effective crossover); 1.0 disables the effect."),
+    _k("ED25519_TPU_DEVCACHE_TABLES", "opt-out", True,
+       "Set to 0/false/no to disable the resident-multiples-TABLES "
+       "entry kind of the device operand cache (the round-8 hot path "
+       "that skips in-kernel table construction for recurring "
+       "keysets); head-operand residency is unaffected."),
+    _k("ED25519_TPU_DEVCACHE_TABLES_HOT_SCALE", "float", 0.75,
+       "Factor applied to the N* crossover model's per-TERM cost `b` "
+       "when the dispatched keyset's multiples tables are device-"
+       "resident (cheaper per-term work RAISES the effective "
+       "crossover); 1.0 disables the effect."),
+    _k("ED25519_TPU_MIN_LANES", "int", None,
+       "Floor on the padded device lane count, so many small batches "
+       "share ONE padded shape and therefore one kernel compile (the "
+       "tier-1 device-parity tests pin 128); unset/0 keeps tight "
+       "padding."),
     _k("ED25519_TPU_DEVCACHE_TENANT_QUOTA", "int", 0,
        "Per-tenant device-operand-cache residency quota in bytes "
        "(cache QoS): >0 partitions the byte budget so one tenant's "

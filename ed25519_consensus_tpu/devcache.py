@@ -33,6 +33,23 @@ exactly this recurrence with the keyset-blob cache
   the dispatch falls back to cold staging.  A corruption that exists
   only in the device copy is caught one rung later by the scheduler's
   host confirmation of device rejects (docs/failure-model.md).
+* **Resident multiples TABLES (round 8).**  A second entry KIND per
+  digest (`KIND_TABLES`) pins the head lanes' `[0..8]P` multiples
+  tables — `(9, 4, NLIMBS, 2·(m+1))` int16, built on the host in exact
+  arithmetic at the same second-sight moment as the head entry.  With
+  tables resident the dispatch skips in-kernel table construction for
+  every head lane (`ops.msm.dispatch_window_sums_many_tables` /
+  `ops.pallas_msm.pallas_window_sums_many_tables`): the kernel's
+  stage-1 point-adds run only for the per-signature R lanes, and ONE
+  resident table feeds the whole batch axis (the coalesced-keys
+  form).  Tables entries ride exactly the same consensus machinery as
+  head entries — SHA-256 pinned to host-built bytes, re-hashed on
+  every hit, staled by global and tenant epochs, LRU-evicted against
+  the same byte budget and tenant quotas, faulted through
+  SITE_DEVCACHE — and degrade one rung gentler: a tables miss falls
+  back to the head-resident dispatch (in-kernel rebuild), then to cold
+  staging (docs/failure-model.md).  `ED25519_TPU_DEVCACHE_TABLES=0`
+  disables the kind.
 * **Budget + deterministic LRU.**  Residency is bounded by
   `ED25519_TPU_DEVCACHE_BYTES` (host-mirror bytes; the device copy is
   the same size per dispatch mode).  Eviction is strict
@@ -85,8 +102,19 @@ from .utils import metrics as _metrics
 
 __all__ = [
     "ResidentKeyset", "DeviceOperandCache", "default_cache",
-    "set_default_cache", "keyset_digest",
+    "set_default_cache", "keyset_digest", "KIND_HEAD", "KIND_TABLES",
 ]
+
+# Entry kinds (round 8): a keyset digest can hold up to two resident
+# tensors — the head OPERAND tensor (the extended-coordinate limbs the
+# round-7 cache pinned) and the head MULTIPLES-TABLES tensor
+# ([0..8]P per head lane, 9× the bytes), which lets the kernel skip
+# table construction entirely for a recurring keyset.  Both kinds ride
+# the same machinery end to end: SHA-256 hash pinning over host-built
+# bytes, per-hit re-hash, global + tenant epoch staleness, LRU byte
+# budget, tenant quotas, and the SITE_DEVCACHE fault seam.
+KIND_HEAD = "head"
+KIND_TABLES = "tables"
 
 
 def keyset_digest(keyset_blob: bytes) -> bytes:
@@ -97,19 +125,25 @@ def keyset_digest(keyset_blob: bytes) -> bytes:
 
 class ResidentKeyset:
     """One resident keyset entry: the host mirror of the precomputed
-    head operand tensor, its pinned hash, the build epoch, and the
-    per-dispatch-mode device array handles."""
+    head tensor (operand limbs for kind="head", multiples tables for
+    kind="tables" — the attribute keeps the historical `head_tensor`
+    name so the fault seam's corruption model covers both kinds), its
+    pinned hash, the build epoch, and the per-dispatch-mode device
+    array handles."""
 
     __slots__ = ("digest", "n_keys", "head_tensor", "head_hash",
-                 "epoch", "tenant", "tenant_epoch", "nbytes",
+                 "epoch", "tenant", "tenant_epoch", "nbytes", "kind",
                  "_device_refs", "_seq")
 
     def __init__(self, digest: bytes, n_keys: int, head_tensor,
                  epoch: int, tenant: str = _tenancy.DEFAULT_TENANT,
-                 tenant_epoch: int = 0):
+                 tenant_epoch: int = 0, kind: str = KIND_HEAD):
         self.digest = digest
         self.n_keys = int(n_keys)
-        self.head_tensor = head_tensor  # (4, NLIMBS, 2*(n_keys+1)) int16
+        self.kind = kind
+        # kind="head":   (4, NLIMBS, 2*(n_keys+1)) int16
+        # kind="tables": (9, 4, NLIMBS, 2*(n_keys+1)) int16
+        self.head_tensor = head_tensor
         self.head_hash = hashlib.sha256(head_tensor.tobytes()).digest()
         self.epoch = int(epoch)
         # Tenancy (cache QoS): the partition this entry's bytes count
@@ -184,7 +218,9 @@ class DeviceOperandCache:
         self.tenant_quota_bytes = int(tenant_quota_bytes)
         self.enabled = bool(enabled) and self.budget_bytes > 0
         self._lock = threading.Lock()
-        self._entries: "dict[bytes, ResidentKeyset]" = {}
+        # (digest, kind) -> entry: one digest can hold a head entry and
+        # a tables entry, evicted/staled/hashed independently.
+        self._entries: "dict[tuple[bytes, str], ResidentKeyset]" = {}
         self._seen: "set[bytes]" = set()
         self._seen_max = 1 << 16
         self._epoch = 0
@@ -238,9 +274,10 @@ class DeviceOperandCache:
                 # RESIDENT digest (wholesale clearing would silently
                 # revert hot tenants to the shared default partition),
                 # drop only the non-resident remainder.
+                resident = {d for d, _k in self._entries}
                 self._tenant_of = {
                     d: t for d, t in self._tenant_of.items()
-                    if d in self._entries}
+                    if d in resident}
             self._tenant_of[digest] = tenant
 
     def tenant_of(self, digest: "bytes | None") -> str:
@@ -299,9 +336,9 @@ class DeviceOperandCache:
                     "resident_bytes": sum(
                         e.nbytes for e in self._entries.values()
                         if e.tenant == t),
-                    "resident_keysets": sum(
-                        1 for e in self._entries.values()
-                        if e.tenant == t),
+                    "resident_keysets": len({
+                        e.digest for e in self._entries.values()
+                        if e.tenant == t}),
                     "epoch": self._tenant_epoch.get(t, 0),
                     "hit_rate": (c.get("hits", 0) / looked
                                  if looked else None),
@@ -326,34 +363,94 @@ class DeviceOperandCache:
             return sum(e.nbytes for e in self._entries.values())
 
     def resident_count(self) -> int:
+        """Distinct resident KEYSETS (digests) — a keyset holding both
+        a head entry and a tables entry counts once; `resident_entries`
+        in stats() carries the raw entry count."""
         with self._lock:
-            return len(self._entries)
+            return len({d for d, _k in self._entries})
 
     # -- lookup / build ----------------------------------------------------
 
     def probe(self, digest: "bytes | None") -> "dict":
         """Non-mutating cache-temperature read for the routing layer:
-        {"hit": bool, "resident_bytes": int}.  Counts nothing, touches
-        no recency — routing must not perturb the hit/miss stream."""
+        {"hit": bool, "tables_hit": bool, "resident_bytes": int}.
+        Counts nothing, touches no recency — routing must not perturb
+        the hit/miss stream.  `tables_hit` is the second temperature
+        axis (round 8): a tables-resident keyset skips in-kernel table
+        construction, which lowers the per-TERM device cost and so
+        RAISES the effective N* crossover (routing.py
+        tables_hot_scale).  It reports True only when the tables
+        DISPATCH is actually reachable — head entry hot too (the
+        dispatch needs both) and the ED25519_TPU_DEVCACHE_TABLES knob
+        on — so routing never models the cheapest dispatch form for a
+        chunk that will stage colder."""
+        tables_on = _config.get("ED25519_TPU_DEVCACHE_TABLES")
         with self._lock:
-            e = self._entries.get(digest) if digest is not None else None
-            hot = (e is not None and e.epoch == self._epoch
-                   and e.tenant_epoch == self._tenant_epoch.get(
-                       e.tenant, 0)
-                   and self.enabled)
-            return {"hit": bool(hot),
+            def hot(kind):
+                e = (self._entries.get((digest, kind))
+                     if digest is not None else None)
+                return bool(
+                    e is not None and e.epoch == self._epoch
+                    and e.tenant_epoch == self._tenant_epoch.get(
+                        e.tenant, 0)
+                    and self.enabled)
+
+            head_hot = hot(KIND_HEAD)
+            return {"hit": head_hot,
+                    "tables_hit": bool(head_hot and tables_on
+                                       and hot(KIND_TABLES)),
                     "resident_bytes": sum(
                         x.nbytes for x in self._entries.values())}
 
-    def lookup(self, digest: bytes) -> "ResidentKeyset | None":
+    def can_admit_tables(self, digest: "bytes | None",
+                         tables_nbytes: int) -> bool:
+        """Would a kind="tables" build of `tables_nbytes` be admitted
+        AND leave this digest's head entry co-resident?  The cheap
+        pre-check batch.py consults BEFORE paying the host-exact table
+        build, mirroring build()'s own refusal rules exactly:
+
+        * the head + tables pair must fit the global budget (a tables
+          entry whose admission would LRU-evict its own head entry just
+          thrashes: head rebuild evicts tables, tables build evicts
+          head, every other chunk stages cold and pays the host build);
+        * with tenant quotas armed, the pair must fit the quota AND the
+          budget net of other tenants' bytes (build()'s
+          oversubscription refusal — without modelling it here a
+          crowded budget would pay the host build and get
+          quota_rejected on every single chunk)."""
+        if not self.enabled or digest is None:
+            return False
+        with self._lock:
+            head = self._entries.get((digest, KIND_HEAD))
+            need = int(tables_nbytes) + (
+                head.nbytes if head is not None else 0)
+            if need > self.budget_bytes:
+                return False
+            quota = self.tenant_quota_bytes
+            if quota > 0:
+                if need > quota:
+                    return False
+                tenant = self._tenant_of.get(digest,
+                                             _tenancy.DEFAULT_TENANT)
+                other = sum(e.nbytes for e in self._entries.values()
+                            if e.tenant != tenant)
+                if other + need > self.budget_bytes:
+                    return False
+            return True
+
+    def lookup(self, digest: bytes,
+               kind: str = KIND_HEAD) -> "ResidentKeyset | None":
         """The dispatch-time lookup: returns a hash-rechecked, current-
-        epoch entry or None (miss / stale / corrupt — all of which mean
-        "stage cold").  Passes through the SITE_DEVCACHE fault seam;
-        publishes the hit/miss/evict/bytes gauges."""
+        epoch entry of the given kind or None (miss / stale / corrupt —
+        all of which mean "stage cold"; for kind="tables" the fallback
+        is one rung gentler: the head-resident dispatch, then cold).
+        Passes through the SITE_DEVCACHE fault seam; publishes the
+        hit/miss/evict/bytes gauges."""
         if not self.enabled:
             return None
         entry = _faults.run_device_call(
-            _faults.SITE_DEVCACHE, lambda: self._lookup_locked(digest),
+            _faults.SITE_DEVCACHE,
+            lambda: self._lookup_locked((digest, kind)),
             payload=self)
         stale_tenant = False
         entry_tenant = None if entry is None else entry.tenant
@@ -363,7 +460,7 @@ class DeviceOperandCache:
             # dispatch could use the rotten bytes.
             if entry.epoch != self._current_epoch():
                 stale_tenant = True  # global staleness tallies too
-                self._drop(digest, "stale_epoch")
+                self._drop((digest, kind), "stale_epoch")
                 _metrics.record_fault("devcache_stale_epoch")
                 entry = None
             elif entry.tenant_epoch != self.tenant_epoch_of(entry.tenant):
@@ -374,11 +471,11 @@ class DeviceOperandCache:
                 # rebuild under the new tenant epoch.  Other tenants'
                 # entries never enter this branch.
                 stale_tenant = True
-                self._drop(digest, "stale_epoch")
+                self._drop((digest, kind), "stale_epoch")
                 _metrics.record_fault("devcache_stale_epoch")
                 entry = None
             elif not entry.recheck():
-                self._drop(digest, "restage_hash_mismatch")
+                self._drop((digest, kind), "restage_hash_mismatch")
                 _metrics.record_fault("devcache_restage_hash_mismatch")
                 entry = None
         with self._lock:
@@ -403,17 +500,17 @@ class DeviceOperandCache:
         with self._lock:
             return self._epoch
 
-    def _lookup_locked(self, digest):
+    def _lookup_locked(self, key):
         with self._lock:
-            e = self._entries.get(digest)
+            e = self._entries.get(key)
             if e is not None:
                 self._lookup_seq += 1
                 e._seq = self._lookup_seq
             return e
 
-    def _drop(self, digest: bytes, counter: str) -> None:
+    def _drop(self, key: "tuple[bytes, str]", counter: str) -> None:
         with self._lock:
-            if self._entries.pop(digest, None) is not None:
+            if self._entries.pop(key, None) is not None:
                 self.counters[counter] += 1
 
     def should_build(self, digest: bytes) -> bool:
@@ -430,12 +527,15 @@ class DeviceOperandCache:
             return False
 
     def build(self, digest: bytes, n_keys: int,
-              head_tensor) -> "ResidentKeyset | None":
+              head_tensor,
+              kind: str = KIND_HEAD) -> "ResidentKeyset | None":
         """Install a resident entry built from HOST-staged bytes
-        (`StagedBatch.head_tensor()`), evicting least-recently-used
-        entries past the byte budget.  Returns the entry, or None when
-        the tensor alone exceeds the whole budget (a keyset too large
-        to ever be resident — cold staging is the steady state then).
+        (`StagedBatch.head_tensor()` for kind="head",
+        `StagedBatch.head_tables_tensor()` for kind="tables"), evicting
+        least-recently-used entries past the byte budget.  Returns the
+        entry, or None when the tensor alone exceeds the whole budget
+        (a keyset too large to ever be resident — cold staging is the
+        steady state then).
 
         With per-tenant quotas armed (`tenant_quota_bytes > 0`)
         eviction is PARTITIONED: only entries of the building digest's
@@ -500,10 +600,21 @@ class DeviceOperandCache:
                 entry = ResidentKeyset(
                     digest, n_keys, head_tensor, self._epoch,
                     tenant=tenant,
-                    tenant_epoch=self._tenant_epoch.get(tenant, 0))
+                    tenant_epoch=self._tenant_epoch.get(tenant, 0),
+                    kind=kind)
+                if kind == KIND_TABLES:
+                    # The pair travels together: refresh the same
+                    # digest's HEAD recency first, so this build's own
+                    # eviction pass can never pick the head entry the
+                    # tables exist to serve beside (the self-thrash
+                    # can_admit_tables also pre-checks against).
+                    head = self._entries.get((digest, KIND_HEAD))
+                    if head is not None:
+                        self._lookup_seq += 1
+                        head._seq = self._lookup_seq
                 self._lookup_seq += 1
                 entry._seq = self._lookup_seq
-                self._entries[digest] = entry
+                self._entries[(digest, kind)] = entry
 
             def evict_own() -> bool:
                 own = [e for e in self._entries.values()
@@ -511,7 +622,7 @@ class DeviceOperandCache:
                 if len(own) <= 1:
                     return False
                 victim = min(own, key=lambda e: e._seq)
-                del self._entries[victim.digest]
+                del self._entries[(victim.digest, victim.kind)]
                 self.counters["evictions"] += 1
                 self._tenant_tally_locked(tenant, "evictions")
                 return True
@@ -533,7 +644,7 @@ class DeviceOperandCache:
                        and len(self._entries) > 1):
                     victim = min(self._entries.values(),
                                  key=lambda e: e._seq)
-                    del self._entries[victim.digest]
+                    del self._entries[(victim.digest, victim.kind)]
                     self.counters["evictions"] += 1
                     self._tenant_tally_locked(victim.tenant,
                                               "evictions")
@@ -558,7 +669,10 @@ class DeviceOperandCache:
                 "tenant_quota_bytes": self.tenant_quota_bytes,
                 "resident_bytes": sum(
                     e.nbytes for e in self._entries.values()),
-                "resident_keysets": len(self._entries),
+                "resident_keysets": len({d for d, _k in self._entries}),
+                "resident_entries": len(self._entries),
+                "resident_tables": sum(
+                    1 for _d, k in self._entries if k == KIND_TABLES),
                 "epoch": self._epoch,
                 "tenants": sorted(
                     {e.tenant for e in self._entries.values()}),

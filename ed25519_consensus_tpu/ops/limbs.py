@@ -107,18 +107,39 @@ WINDOW_BITS = 4
 NWINDOWS = 33
 PACKED_WINDOWS = (NWINDOWS + 1) // 2  # nibble-packed digit planes
 
+# Signed radix-32 (the round-8 kernel-variant sweep): 5-bit windows cut
+# the window count 33 → 27 (26 windows cover 130 ≥ 128 bits, plus the
+# carry window) at the price of a 17-entry multiples table ([0..16]P —
+# digits live in [-16, 15], |d| ≤ 16).  Table build grows 8 → 16
+# point-adds per lane block while the per-window select/fold work drops
+# ~18%; which side wins is a hardware question tools/kernel_lab.py
+# measures.  Radix-32 digits do NOT fit a signed nibble, so this radix
+# has no packed digit wire — the plane count (27 vs 33) and the kernel
+# variant key carry the radix end to end.
+WINDOW_BITS_R32 = 5
+NWINDOWS_R32 = 27
 
-def _recode_signed(d_le: np.ndarray) -> np.ndarray:
-    """Unsigned little-endian nibble digits (n, W) → signed digits
-    (n, W+1) int8 with every digit in [-8, 7]: d ≥ 8 becomes d - 16 with
-    a carry into the next window (vectorized over the batch)."""
+
+def windows_for_bits(window_bits: int, scalar_bits: int = 128) -> int:
+    """Signed-digit plane count for `scalar_bits`-bit scalars at the
+    given window width: ceil(scalar_bits / window_bits) unsigned
+    windows + 1 carry window from the signed recoding."""
+    return -(-scalar_bits // window_bits) + 1
+
+
+def _recode_signed(d_le: np.ndarray, radix: int = 16) -> np.ndarray:
+    """Unsigned little-endian radix digits (n, W) → signed digits
+    (n, W+1) int8 with every digit in [-radix/2, radix/2 - 1]:
+    d ≥ radix/2 becomes d - radix with a carry into the next window
+    (vectorized over the batch)."""
     n, W = d_le.shape
+    half = radix // 2
     out = np.zeros((n, W + 1), dtype=np.int8)
     carry = np.zeros(n, dtype=np.int32)
     for w in range(W):
         v = d_le[:, w].astype(np.int32) + carry
-        carry = (v >= 8).astype(np.int32)
-        out[:, w] = (v - 16 * carry).astype(np.int8)
+        carry = (v >= half).astype(np.int32)
+        out[:, w] = (v - radix * carry).astype(np.int8)
     out[:, W] = carry.astype(np.int8)
     return out
 
@@ -147,21 +168,27 @@ def pack_digit_planes(digits: np.ndarray) -> np.ndarray:
     return packed
 
 
-def pack_scalar_windows(scalars, nwindows: int = NWINDOWS) -> np.ndarray:
-    """Pack scalars (< 16^(nwindows-1)) into MSB-first SIGNED radix-16
-    digit planes (nwindows, N) int8, digits in [-8, 7] (vectorized via
-    np.unpackbits + carry recoding)."""
-    nub = nwindows - 1  # unsigned nibble windows before recoding
-    nbytes = (nub * WINDOW_BITS + 7) // 8
+def pack_scalar_windows(scalars, nwindows: int = NWINDOWS,
+                        window_bits: int = WINDOW_BITS) -> np.ndarray:
+    """Pack scalars (< 2^((nwindows-1)·window_bits)) into MSB-first
+    SIGNED radix-2^window_bits digit planes (nwindows, N) int8, digits
+    in [-2^(window_bits-1), 2^(window_bits-1) - 1] (vectorized via
+    np.unpackbits + carry recoding).  The default is the production
+    radix-16 wire; window_bits=5 is the radix-32 kernel-variant
+    packing (NWINDOWS_R32 planes)."""
+    nub = nwindows - 1  # unsigned windows before recoding
+    nbytes = (nub * window_bits + 7) // 8
     for s in scalars:
-        if s >> (nub * WINDOW_BITS):
-            raise ValueError(f"scalar exceeds {nub} radix-16 windows")
-    bits = _ints_to_bits(scalars, nbytes)[:, : nub * WINDOW_BITS]
-    w = (1 << np.arange(WINDOW_BITS, dtype=np.int32)).astype(np.int32)
-    digits = bits.reshape(len(scalars), nub, WINDOW_BITS).astype(
+        if s >> (nub * window_bits):
+            raise ValueError(
+                f"scalar exceeds {nub} radix-{1 << window_bits} windows")
+    bits = _ints_to_bits(scalars, nbytes)[:, : nub * window_bits]
+    w = (1 << np.arange(window_bits, dtype=np.int32)).astype(np.int32)
+    digits = bits.reshape(len(scalars), nub, window_bits).astype(
         np.int32
     ) @ w  # (N, nub) little-endian window order
-    return np.ascontiguousarray(_recode_signed(digits)[:, ::-1].T)
+    return np.ascontiguousarray(
+        _recode_signed(digits, radix=1 << window_bits)[:, ::-1].T)
 
 
 def pack_points_from_raw(raw: np.ndarray) -> np.ndarray:

@@ -110,19 +110,22 @@ _shapes_completed = set()
 
 
 def mark_shape_completed(n_batches: int, n_lanes: int,
-                         mesh: int = 0, cached: bool = False) -> None:
+                         mesh: int = 0, cached: "bool | int" = False
+                         ) -> None:
     _shapes_completed.add((int(n_batches), int(n_lanes), int(mesh or 0),
-                           bool(cached)))
+                           int(cached)))
 
 
 def shape_completed(n_batches: int, n_lanes: int, mesh: int = 0,
-                    cached: bool = False) -> bool:
-    """`cached` keys the devcache dispatch separately: the cache-aware
-    kernel entry is a DIFFERENT executable from the cold-path kernel at
-    the same (B, N), so its first call deserves its own compile
-    grace."""
+                    cached: "bool | int" = False) -> bool:
+    """`cached` keys the devcache dispatches separately: the cache-aware
+    kernel entries are DIFFERENT executables from the cold-path kernel
+    at the same (B, N), so each one's first call deserves its own
+    compile grace.  It is a small int variant tag (0 = cold, 1 = the
+    resident-head dispatch, 2 = the resident-TABLES dispatch); passing
+    a bool keeps the historical meaning (True == 1)."""
     return (int(n_batches), int(n_lanes), int(mesh or 0),
-            bool(cached)) in _shapes_completed
+            int(cached)) in _shapes_completed
 
 
 _MIN_LANES = 8  # keep tiny test batches cheap; bench batches are ≥ 128
@@ -141,9 +144,21 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _lane_floor() -> int:
+    """ED25519_TPU_MIN_LANES: a floor on the padded lane count, so many
+    small dispatches share ONE padded shape (and therefore one kernel
+    compile).  The tier-1 device-parity tests pin it to 128 — the whole
+    parity file then pays a single executable — and services with
+    mixed tiny batches can use it to stop per-shape compiles.  Unset/0
+    keeps the historical tight padding."""
+    v = _config.get("ED25519_TPU_MIN_LANES")
+    return int(v) if v else 0
+
+
 def _pad_lanes(n: int) -> int:
     """Lane count for n terms: a multiple of GROUP_LANES (tight — padding is
     pure wasted work), or a small power of two for tiny batches."""
+    n = max(n, _lane_floor())
     if n <= GROUP_LANES:
         return max(_MIN_LANES, _next_pow2(n))
     return -(-n // GROUP_LANES) * GROUP_LANES
@@ -169,12 +184,53 @@ def split_terms(scalars, points, shifts=None):
     return out_s, out_p
 
 
+def _table_entries(window_bits: int) -> int:
+    """Multiples-table length for a signed radix: [0..2^(wb-1)]P —
+    signed digits need only half a table, negation is free on balanced
+    limbs.  9 entries for the production radix-16, 17 for radix-32."""
+    return (1 << (window_bits - 1)) + 1
+
+
+def table_scan(points, window_bits: int = WINDOW_BITS):
+    """The per-term multiples tables as a traced jnp function: points
+    (4, NLIMBS, ..., N) int* → ([0..k]P table, k = 2^(wb-1)) of shape
+    (k+1, 4, NLIMBS, ..., N) int16.  This is stage 1 of the XLA scan
+    kernel, factored out so the tables-resident dispatch can build the
+    per-signature R tables ON DEVICE inside the same jit (and so the
+    devcache/kernel-lab paths share one copy of the math).  The int16
+    cast is exact: jnp_edwards.point_add outputs live in the U bound
+    (|limb| ≤ 8191, jnp_field closure proofs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import jnp_edwards as E
+
+    points = points.astype(jnp.int32)
+
+    def table_body(t, _):
+        nxt = E.point_add(t, points)
+        return nxt, nxt
+
+    _, multiples = jax.lax.scan(
+        table_body, E.identity_like(points), None,
+        length=_table_entries(window_bits) - 1
+    )  # (k, 4, NLIMBS, ..., N) = [1]P .. [k]P
+    return jnp.concatenate(
+        [E.identity_like(points)[None], multiples], axis=0
+    ).astype(jnp.int16)  # (k+1, 4, NLIMBS, ..., N)
+
+
 @functools.lru_cache(maxsize=None)
-def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
+def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS,
+                     window_bits: int = WINDOW_BITS,
+                     tables_in: bool = False):
     """Build and jit the windowed per-window-sum kernel for a fixed lane
     count.
-    Input: digits (nwin, N) int8, SIGNED digits in [-8, 7], MSB-first;
-           points (4, NLIMBS, N) int16.
+    Input: digits (nwin, N) int8, SIGNED digits in [-2^(wb-1),
+           2^(wb-1) - 1], MSB-first; points (4, NLIMBS, N) int16 — or,
+           with `tables_in`, the PREBUILT multiples tables
+           (k+1, 4, NLIMBS, N) int16 instead of points (the
+           resident-tables hot path skips stage 1 entirely).
     Output: (4, NLIMBS, nwin) int32 — the per-window sums S_w."""
     ensure_compile_cache()
     import jax
@@ -185,28 +241,24 @@ def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
     G = min(n_lanes, GROUP_LANES)
     assert n_lanes % G == 0
     n_blocks = n_lanes // G
+    n_tbl = _table_entries(window_bits)
 
     def kernel(digits, points):
         digits = digits.astype(jnp.int32)
-        points = points.astype(jnp.int32)
 
-        # --- stage 1: per-term multiples tables ([0..8]P — signed digits
-        # need only half a table; negation is free on balanced limbs) ----
-        def table_body(t, _):
-            nxt = E.point_add(t, points)
-            return nxt, nxt
-
-        _, multiples = jax.lax.scan(
-            table_body, E.identity_like(points), None, length=8
-        )  # (8, 4, NLIMBS, N) = [1]P .. [8]P
-        table = jnp.concatenate(
-            [E.identity_like(points)[None], multiples], axis=0
-        )  # (9, 4, NLIMBS, N)
+        # --- stage 1: per-term multiples tables ([0..k]P — signed
+        # digits need only half a table; negation is free on balanced
+        # limbs).  The tables-resident variant receives the table as
+        # its second operand and skips the build. -----------------------
+        if tables_in:
+            table = points.astype(jnp.int32)  # (n_tbl, 4, NLIMBS, N)
+        else:
+            table = table_scan(points, window_bits).astype(jnp.int32)
 
         # --- stage 2: per-window sums over lane blocks -----------------
         tbl_blocks = jnp.moveaxis(
-            table.reshape(9, 4, NLIMBS, n_blocks, G), 3, 0
-        )  # (B, 9, 4, NLIMBS, G)
+            table.reshape(n_tbl, 4, NLIMBS, n_blocks, G), 3, 0
+        )  # (B, n_tbl, 4, NLIMBS, G)
         dig_blocks = jnp.moveaxis(
             digits.reshape(nwin, n_blocks, G), 1, 0
         )  # (B, nwin, G)
@@ -215,8 +267,9 @@ def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
             tbl, dig = xs
             mag = jnp.abs(dig)
             onehot = (
-                mag[:, None, :] == jnp.arange(9, dtype=jnp.int32)[None, :, None]
-            ).astype(jnp.int32)  # (nwin, 9, G)
+                mag[:, None, :]
+                == jnp.arange(n_tbl, dtype=jnp.int32)[None, :, None]
+            ).astype(jnp.int32)  # (nwin, n_tbl, G)
             # Exact select: for each (window, lane), pick the |digit|'s
             # table entry.  Broadcast-multiply + sum over the 9-entry axis
             # (NOT einsum/dot_general — integer dots lower poorly on TPU);
@@ -249,11 +302,14 @@ def _compiled_kernel(n_lanes: int, nwin: int = NWINDOWS):
     return jax.jit(kernel)
 
 
-def pack_msm_operands(scalars, points, n_lanes: int | None = None):
+def pack_msm_operands(scalars, points, n_lanes: int | None = None,
+                      window_bits: int = WINDOW_BITS):
     """Pack 128-bit (scalars, host Points) into padded device operands.
 
     Returns (digits, point_limbs) numpy arrays of shapes
-    (NWINDOWS, N) / (4, NLIMBS, N) with N = _pad_lanes(len).
+    (nwindows, N) / (4, NLIMBS, N) with N = _pad_lanes(len) and
+    nwindows the signed plane count for `window_bits` (NWINDOWS for
+    the production radix-16, NWINDOWS_R32 for the radix-32 variant).
     Padding terms are scalar 0 on the identity point."""
     scalars = [int(s) for s in scalars]
     if len(scalars) != len(points):
@@ -262,20 +318,26 @@ def pack_msm_operands(scalars, points, n_lanes: int | None = None):
     N = n_lanes if n_lanes is not None else _pad_lanes(n)
     if N < n:
         raise ValueError("n_lanes must be ≥ len(scalars)")
-    digits = np.zeros((NWINDOWS, N), dtype=np.int8)
+    nwin = (NWINDOWS if window_bits == limbs.WINDOW_BITS
+            else limbs.windows_for_bits(window_bits))
+    digits = np.zeros((nwin, N), dtype=np.int8)
     if n:
-        digits[:, :n] = limbs.pack_scalar_windows(scalars, NWINDOWS)
+        digits[:, :n] = limbs.pack_scalar_windows(scalars, nwin,
+                                                  window_bits)
     pts = limbs.identity_point_batch(N)
     if n:
         pts[..., :n] = limbs.pack_point_batch(points).astype(np.int16)
     return digits, pts
 
 
-def combine_window_sums(window_sums) -> Point:
+def combine_window_sums(window_sums,
+                        window_bits: int = WINDOW_BITS) -> Point:
     """Exact host Horner combine of the device per-window sums (MSB first):
-    acc ← [16]acc + S_w.  ~32·(4 dbl + 1 add) exact bigint point ops — the
-    serial tail that would be pure latency on the device.  Accepts a
-    leading singleton batch axis."""
+    acc ← [2^wb]acc + S_w.  ~32·(4 dbl + 1 add) exact bigint point ops —
+    the serial tail that would be pure latency on the device.  Accepts a
+    leading singleton batch axis.  `window_bits` must match the radix
+    the digit planes were packed with (radix-32 planes take 5 doublings
+    per window)."""
     ws = np.asarray(window_sums)
     if ws.ndim == 4:
         if ws.shape[0] != 1:
@@ -283,7 +345,7 @@ def combine_window_sums(window_sums) -> Point:
         ws = ws[0]
     acc = Point(0, 1, 1, 0)
     for w in range(ws.shape[-1]):
-        for _ in range(WINDOW_BITS):
+        for _ in range(window_bits):
             acc = acc.double()
         acc = acc.add(limbs.unpack_point(ws[..., w]))
     return acc
@@ -530,6 +592,121 @@ def dispatch_window_sums_many_cached(digits, head, rwire):
         pts = _compiled_assemble_cached(
             rwire.shape[0], head.shape[-1], rwire.shape[-1])(head, rwire)
         return dispatch_window_sums_many(digits, pts)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_table_builder(n_batches: int, n_lanes: int,
+                            window_bits: int = WINDOW_BITS):
+    """jit of the standalone multiples-table build: extended points
+    (B, 4, NLIMBS, N) int16 → (B, k+1, 4, NLIMBS, N) int16 tables.
+    Used by devcache warming/benches and the kernel lab to prebuild
+    full-lane tables; the hot dispatch builds its R-lane tables inline
+    instead (one jit, no extra device call)."""
+    ensure_compile_cache()
+    import jax
+
+    def f(points):
+        return jax.vmap(
+            lambda p: table_scan(p, window_bits))(points)
+
+    return jax.jit(f)
+
+
+def build_multiples_tables(points, window_bits: int = WINDOW_BITS):
+    """Device-built multiples tables for a batch of extended points:
+    (B, 4, NLIMBS, N) int16 → (B, k+1, 4, NLIMBS, N) int16 device
+    array, k = 2^(wb-1).  Row 0 is the identity, row 1 the point
+    itself, row j the exact [j]P — limbs in the U bound, so the int16
+    storage is exact (jnp_field closure proofs)."""
+    with DEVICE_CALL_LOCK:
+        return _compiled_table_builder(
+            points.shape[0], points.shape[-1], window_bits)(points)
+
+
+def assemble_tables_operands(digits, head_tables, rwire,
+                             n_batches: int, dwire: str):
+    """The ONE in-jit composition of the tables hot path, shared by the
+    XLA dispatch below and the Mosaic pipeline
+    (pallas_msm._compiled_tables_pipeline) so the two backends can
+    never silently diverge: expand packed digit planes, expand the
+    compressed R wire, build the R lanes' multiples tables on device,
+    broadcast the RESIDENT head tables across the batch axis, and
+    concatenate into the full-lane (B, 9, 4, NLIMBS, N) int16 table
+    batch.  Returns (plain digits, tables)."""
+    import jax
+    import jax.numpy as jnp
+
+    if dwire == "packed":
+        digits = expand_digits(digits)
+    r_pts = expand_points(rwire, "compressed")  # (B, 4, NLIMBS, n_r)
+    r_tbl = jax.vmap(table_scan)(r_pts)  # (B, k+1, 4, NLIMBS, n_r)
+    h = jnp.broadcast_to(
+        head_tables[None].astype(jnp.int16),
+        (n_batches,) + head_tables.shape)
+    tables = jnp.concatenate([h, r_tbl.astype(jnp.int16)], axis=-1)
+    return digits, tables
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_tables_dispatch(n_batches: int, n_head: int, n_r: int,
+                              nwin: int = NWINDOWS,
+                              dwire: str = "plain"):
+    """The resident-TABLES hot path (round 8): ONE jit that
+
+    1. expands the per-signature compressed R wire to extended points,
+    2. builds the R lanes' multiples tables on device (stage-1 work for
+       the only lanes whose points actually change per call),
+    3. broadcasts the RESIDENT head tables — committed to the device
+       once per keyset, shared across the whole batch axis — alongside
+       them, and
+    4. runs the tables-input window-sum kernel, which skips table
+       construction entirely.
+
+    A recurring keyset therefore pays stage-1 point-adds only for its
+    per-signature R lanes (~n_sigs of n_head + n_sigs lanes); the head
+    tables never cross the link and are never rebuilt.  Integer-only
+    end to end (audited: `xla-tables-ref` in the jaxpr manifest)."""
+    ensure_compile_cache()
+    import jax
+
+    kernel = _compiled_kernel.__wrapped__(
+        n_head + n_r, nwin, tables_in=True)
+
+    def f(digits, head_tables, rwire):
+        digits, tables = assemble_tables_operands(
+            digits, head_tables, rwire, n_batches, dwire)
+        return jax.vmap(kernel)(digits, tables)
+
+    return jax.jit(f)
+
+
+def dispatch_window_sums_many_tables(digits, head_tables, rwire):
+    """The hot-path dispatch for a keyset whose MULTIPLES TABLES are
+    resident (devcache.py, kind="tables"): digits (B, PACKED_WINDOWS,
+    N) for all N = n_head + n_r lanes, `head_tables` the entry's
+    committed (9, 4, NLIMBS, n_head) int16 device array, `rwire`
+    (B, 33, n_r) the per-signature R encodings.  The window-sum math is
+    the same exact group arithmetic as the cold path — the tables
+    represent exactly the multiples the in-kernel build would have
+    produced (hash-pinned to host-built bytes), and the Horner combine
+    reduces mod p exactly — so verdicts are identical by construction;
+    only where the table bytes came from differs."""
+    with DEVICE_CALL_LOCK:
+        if _use_pallas():
+            from . import pallas_msm
+
+            out = pallas_msm.pallas_window_sums_many_tables(
+                digits, head_tables, rwire)
+        else:
+            out = _compiled_tables_dispatch(
+                rwire.shape[0], head_tables.shape[-1], rwire.shape[-1],
+                logical_windows(digits),
+                dwire=digit_wire_of(digits))(digits, head_tables, rwire)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
+    return out
 
 
 def device_msm_async(scalars, points, shifts=None) -> PendingMSM:

@@ -162,7 +162,12 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
                                    tile=(SUBLANES, LANES),
                                    tbl_dtype="int16",
                                    win_chunk: int = 1,
-                                   unroll_windows: bool = False):
+                                   unroll_windows: bool = False,
+                                   window_bits: int = 4,
+                                   fold_dtype: str = "int32",
+                                   tables_in: bool = False,
+                                   tables_batched: bool = True,
+                                   select_only: bool = False):
     """The `rolled` kernel body: field elements are whole (NLIMBS, S, L)
     arrays and the select/window loops are `fori_loop`s with dynamic ref
     indices, so the traced body is a few thousand equations instead of
@@ -175,7 +180,28 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
     and table-select loops — sequential `fori_loop`s cost Mosaic its
     cross-window instruction pipelining (measured ~3-5× per-block on
     v5e), while the unrolled schedule recovers it at ~5× the (still
-    small) trace."""
+    small) trace.
+
+    Round-8 variant axes (the ≥500k terms/s sweep, tools/kernel_lab.py):
+
+    * `window_bits=5` — signed radix-32: 27 digit planes instead of 33
+      against a 17-entry [0..16]P table (limbs.py recoding; |d| ≤ 16).
+    * `fold_dtype="int16"` — the in-block sublane fold keeps its
+      intermediates as int16 between halving point-adds.  Exact by the
+      U bound: every `_padd_a` output limb passes through
+      `_carry_a(·, 5)` inside `_fmul_a`, so |limb| ≤ 8191 < 2^15
+      (jnp_field closure proofs); arithmetic still runs int32 — only
+      the stored accumulator narrows.
+    * `tables_in` — the table-RESIDENT variant: the second operand is
+      the PREBUILT multiples table (devcache kind="tables"), blocked
+      (tb, n_tbl, 4, NLIMBS, blocks, S, L); the in-kernel table build
+      is skipped entirely.  With `tables_batched=False` a single table
+      (leading axis 1) is shared across the whole batch grid axis —
+      the coalesced-keys form.
+    * `select_only` — PROFILE-LEDGER DEBUG ONLY (never selectable via
+      env, never verdict-relevant): skip the in-block fold and write a
+      slice of the raw select, isolating select time from fold time
+      for tools/microbench_pallas.py --profile-ledger."""
     from .msm import ensure_compile_cache
 
     ensure_compile_cache()
@@ -187,64 +213,55 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
     S, Ln = tile
     fS = min(FOLD_SUBLANES, S)
     tdt = jnp.int16 if tbl_dtype == "int16" else jnp.int32
+    n_tbl = (1 << (window_bits - 1)) + 1  # [0..2^(wb-1)]P
     W = win_chunk
     assert nwin % W == 0
 
-    def kernel(dig_ref, pts_ref, out_ref, tbl_ref):
+    def body(dig_ref, tbl_read, out_ref, build_table=None):
+        """Shared select/fold schedule; `tbl_read(k)` yields table entry
+        k as an int32 (4, NLIMBS, S, L) array whatever its storage."""
         w = pl.program_id(2)
+        if build_table is not None:
+            @pl.when(w == 0)
+            def _build():
+                build_table()
 
-        # --- table build once per (batch, block), at the first window ----
-        @pl.when(w == 0)
-        def _build_table():
-            pt = pts_ref[0, :, :, 0].astype(jnp.int32)  # (4, NLIMBS, S, L)
-            zero_el = jnp.zeros((NLIMBS, S, Ln), jnp.int32)
-            one_el = jnp.concatenate(
-                [jnp.ones((1, S, Ln), jnp.int32),
-                 jnp.zeros((NLIMBS - 1, S, Ln), jnp.int32)],
-                axis=0,
-            )
-            tbl_ref[0] = jnp.stack(
-                [zero_el, one_el, one_el, zero_el]
-            ).astype(tdt)
-            tbl_ref[1] = pt.astype(tdt)
-
-            def table_body(k, _):
-                prev = tbl_ref[k - 1].astype(jnp.int32)
-                tbl_ref[k] = _padd_a(prev, pt).astype(tdt)
-                return 0
-
-            jax.lax.fori_loop(2, 9, table_body, 0)
-
-        # --- this step's windows: select + in-block lane fold ------------
         def win_body(wi, _):
             d = dig_ref[0, wi, 0].astype(jnp.int32)  # (S, Ln)
             mag = jnp.abs(d)
 
             if unroll_windows:
                 sel = jnp.zeros((4, NLIMBS, S, Ln), jnp.int32)
-                for k in range(9):
+                for k in range(n_tbl):
                     mask = (mag == k).astype(jnp.int32)
-                    sel = sel + mask[None, None] * tbl_ref[k].astype(
-                        jnp.int32)
+                    sel = sel + mask[None, None] * tbl_read(k)
             else:
                 def sel_body(k, sel):
                     mask = (mag == k).astype(jnp.int32)
-                    return sel + mask[None, None] * tbl_ref[k].astype(
-                        jnp.int32)
+                    return sel + mask[None, None] * tbl_read(k)
 
                 sel = jax.lax.fori_loop(
-                    0, 9, sel_body,
+                    0, n_tbl, sel_body,
                     jnp.zeros((4, NLIMBS, S, Ln), jnp.int32),
                 )
             # negative digits: negate X and T (free in balanced limbs)
             sgn = jnp.where(d < 0, jnp.int32(-1), jnp.int32(1))
             one = jnp.ones_like(sgn)
             sel = sel * jnp.stack([sgn, one, one, sgn])[:, None]
-            # fold the sublane rows down by halving point-adds
+            if select_only:  # profile ledger: select time, no fold
+                out_ref[0, 0, wi] = sel[:, :, :fS].astype(jnp.int16)
+                return 0
+            # fold the sublane rows down by halving point-adds.  The
+            # int16 fold variant narrows the STORED accumulator between
+            # adds (exact: _padd_a outputs live in the U bound ≤ 8191);
+            # the adds themselves always run int32.
             s = S
             while s > fS:
                 half = s // 2
-                sel = _padd_a(sel[:, :, :half], sel[:, :, half:])
+                sel = _padd_a(sel[:, :, :half].astype(jnp.int32),
+                              sel[:, :, half:].astype(jnp.int32))
+                if fold_dtype == "int16":
+                    sel = sel.astype(jnp.int16)
                 s = half
             out_ref[0, 0, wi] = sel.astype(jnp.int16)
             return 0
@@ -255,6 +272,51 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
         else:
             jax.lax.fori_loop(0, W, win_body, 0)
 
+    if tables_in:
+        def kernel(dig_ref, tblin_ref, out_ref):
+            def tbl_read(k):
+                return tblin_ref[0, k, :, :, 0].astype(jnp.int32)
+
+            body(dig_ref, tbl_read, out_ref)
+
+        tb_ix = (lambda b, i, w: (b, 0, 0, 0, i, 0, 0)) if tables_batched \
+            else (lambda b, i, w: (0, 0, 0, 0, i, 0, 0))
+        second_spec = pl.BlockSpec(
+            (1, n_tbl, 4, NLIMBS, 1, S, Ln), tb_ix)
+        scratch = []
+    else:
+        def kernel(dig_ref, pts_ref, out_ref, tbl_ref):
+            def build_table():
+                pt = pts_ref[0, :, :, 0].astype(jnp.int32)  # (4,NLIMBS,S,L)
+                zero_el = jnp.zeros((NLIMBS, S, Ln), jnp.int32)
+                one_el = jnp.concatenate(
+                    [jnp.ones((1, S, Ln), jnp.int32),
+                     jnp.zeros((NLIMBS - 1, S, Ln), jnp.int32)],
+                    axis=0,
+                )
+                tbl_ref[0] = jnp.stack(
+                    [zero_el, one_el, one_el, zero_el]
+                ).astype(tdt)
+                tbl_ref[1] = pt.astype(tdt)
+
+                def table_body(k, _):
+                    prev = tbl_ref[k - 1].astype(jnp.int32)
+                    tbl_ref[k] = _padd_a(prev, pt).astype(tdt)
+                    return 0
+
+                jax.lax.fori_loop(2, n_tbl, table_body, 0)
+
+            def tbl_read(k):
+                return tbl_ref[k].astype(jnp.int32)
+
+            body(dig_ref, tbl_read, out_ref, build_table=build_table)
+
+        second_spec = pl.BlockSpec(
+            (1, 4, NLIMBS, 1, S, Ln),
+            lambda b, i, w: (b, 0, 0, i, 0, 0),
+        )
+        scratch = [pltpu.VMEM((n_tbl, 4, NLIMBS, S, Ln), tdt)]
+
     return pl.pallas_call(
         kernel,
         grid=(n_batches, n_blocks, nwin // W),
@@ -262,10 +324,7 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
             pl.BlockSpec(
                 (1, W, 1, S, Ln), lambda b, i, w: (b, w, i, 0, 0)
             ),
-            pl.BlockSpec(
-                (1, 4, NLIMBS, 1, S, Ln),
-                lambda b, i, w: (b, 0, 0, i, 0, 0),
-            ),
+            second_spec,
         ],
         out_specs=pl.BlockSpec(
             (1, 1, W, 4, NLIMBS, fS, Ln),
@@ -275,9 +334,7 @@ def _compiled_pallas_kernel_rolled(n_batches: int, n_blocks: int,
             (n_batches, n_blocks, nwin, 4, NLIMBS, fS, Ln),
             jnp.int16,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((9, 4, NLIMBS, S, Ln), tdt)
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )
 
@@ -309,12 +366,21 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
                        interpret: bool = False, tile=(SUBLANES, LANES),
                        tbl_dtype="int16", win_chunk: int = 1,
                        body: str | None = None, wire: str = "extended",
-                       dwire: str = "plain"):
+                       dwire: str = "plain", window_bits: int = 4,
+                       fold_dtype: str = "int32",
+                       tables_in: bool = False,
+                       tables_batch: int = 0):
     """ONE jitted function for the whole device step: Pallas partial-sum
     kernel + XLA fold of the per-block partials, so a multi-batch
     verification is a single tunnel call.
     (B, nwin, N) int8, (B, 4, NLIMBS, N) int16 → (B, 4, NLIMBS, nwin)
-    int32."""
+    int32.
+
+    With `tables_in`, the second operand is the PREBUILT multiples
+    table batch (tables_batch ∈ {1, B} leading axis; 1 = one table
+    shared across the batch axis, the coalesced-keys form) of shape
+    (TB, n_tbl, 4, NLIMBS, N) int16, and the kernel skips table
+    construction (the resident-tables hot path / kernel-lab variant)."""
     import jax
     import jax.numpy as jnp
 
@@ -325,10 +391,13 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
     assert n_lanes % group == 0
     n_blocks = n_lanes // group
     style = body or _body_style()
+    n_tbl = (1 << (window_bits - 1)) + 1
     kernel = _compiled_pallas_kernel_rolled(
         n_batches, n_blocks, nwin, interpret=interpret, tile=tile,
         tbl_dtype=tbl_dtype, win_chunk=win_chunk,
-        unroll_windows=style == "hybrid",
+        unroll_windows=style == "hybrid", window_bits=window_bits,
+        fold_dtype=fold_dtype, tables_in=tables_in,
+        tables_batched=tables_batch != 1,
     )
     fS = min(FOLD_SUBLANES, S)
 
@@ -337,14 +406,19 @@ def _compiled_pipeline(n_batches: int, n_lanes: int, nwin: int = NWINDOWS,
             from .msm import expand_digits
 
             digits = expand_digits(digits)
-        if wire != "extended":
-            from .msm import expand_points
-
-            points = expand_points(points, wire)
         dig = digits.reshape(n_batches, nwin, n_blocks, S, Ln)
-        pts = points.reshape(
-            n_batches, 4, NLIMBS, n_blocks, S, Ln
-        )
+        if tables_in:
+            tb = tables_batch or n_batches
+            pts = points.reshape(
+                tb, n_tbl, 4, NLIMBS, n_blocks, S, Ln)
+        else:
+            if wire != "extended":
+                from .msm import expand_points
+
+                points = expand_points(points, wire)
+            pts = points.reshape(
+                n_batches, 4, NLIMBS, n_blocks, S, Ln
+            )
         part = kernel(dig, pts)  # (B, nb, nwin, 4, NLIMBS, 8, 128) int16
         # point tensors for the XLA fold must be (4, NLIMBS, ...batch axes)
         acc = jnp.transpose(part, (3, 4, 0, 2, 1, 5, 6)).astype(jnp.int32)
@@ -397,7 +471,7 @@ def _auto_win_chunk(nwin: int) -> int:
         warnings.warn(
             f"ED25519_TPU_WIN_CHUNK={w!r} ignored: must be a positive "
             f"divisor of {nwin}", stacklevel=2)
-    for w in (11, 3):
+    for w in (11, 9, 3):  # 33 → 11; the radix-32 plane count 27 → 9
         if nwin % w == 0:
             return w
     return 1
@@ -406,11 +480,16 @@ def _auto_win_chunk(nwin: int) -> int:
 def pallas_window_sums_many(digits, points, interpret: bool = False,
                             tile=(SUBLANES, LANES), tbl_dtype="int16",
                             win_chunk: int | None = None,
-                            body: str | None = None):
+                            body: str | None = None,
+                            window_bits: int = 4,
+                            fold_dtype: str = "int32"):
     """Batched dispatch: digits (B, nwin, N) int8 (plain or
     nibble-packed — see msm.digit_wire_of), points (B, 4, NLIMBS, N)
     int16 numpy arrays → (B, 4, NLIMBS, nwin) device array, one device
-    call."""
+    call.  `window_bits=5` selects the radix-32 kernel variant (27
+    plain digit planes, 17-entry table); `fold_dtype="int16"` the
+    narrow fold-accumulator variant — both parity-pinned sweep
+    variants, radix-16/int32 remains the production default."""
     from .msm import digit_wire_of, logical_windows, wire_of
 
     B, _, N = digits.shape
@@ -425,7 +504,87 @@ def pallas_window_sums_many(digits, points, interpret: bool = False,
                               win_chunk=win_chunk,
                               body=body,
                               wire=wire_of(points),
-                              dwire=dwire)(digits, points)
+                              dwire=dwire, window_bits=window_bits,
+                              fold_dtype=fold_dtype)(digits, points)
+
+
+def pallas_window_sums_many_tables_full(digits, tables,
+                                        interpret: bool = False,
+                                        tile=(SUBLANES, LANES),
+                                        win_chunk: int | None = None,
+                                        window_bits: int = 4,
+                                        fold_dtype: str = "int32"):
+    """Tables-input dispatch with FULL prebuilt tables: digits
+    (B, nwin, N) int8 plain, tables (TB, n_tbl, 4, NLIMBS, N) int16
+    with TB ∈ {1, B} (TB = 1 shares one table across the batch axis —
+    the coalesced-keys form).  The kernel-lab/parity entry for the
+    table-resident variant; production uses
+    msm.dispatch_window_sums_many_tables (resident head tables +
+    on-device R tables)."""
+    from .msm import digit_wire_of, logical_windows
+
+    B, _, N = digits.shape
+    nwin = logical_windows(digits)
+    if win_chunk is None:
+        win_chunk = _auto_win_chunk(nwin)
+    return _compiled_pipeline(
+        B, N, nwin, interpret=interpret, tile=tile,
+        win_chunk=win_chunk, body="rolled",
+        dwire=digit_wire_of(digits), window_bits=window_bits,
+        fold_dtype=fold_dtype, tables_in=True,
+        tables_batch=tables.shape[0])(digits, tables)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_tables_pipeline(n_batches: int, n_head: int, n_r: int,
+                              nwin: int = NWINDOWS,
+                              interpret: bool = False,
+                              tile=(SUBLANES, LANES),
+                              win_chunk: int = 1,
+                              dwire: str = "packed"):
+    """The Mosaic resident-tables hot path, mirroring
+    msm._compiled_tables_dispatch: ONE jit that expands the compressed
+    R wire, builds the R lanes' tables on device (XLA, pre-kernel),
+    broadcasts the resident head tables along the batch axis, and runs
+    the tables-input Pallas kernel — table construction for the head
+    lanes never happens again for a resident keyset."""
+    from .msm import ensure_compile_cache
+
+    ensure_compile_cache()
+    import jax
+
+    from . import msm as _msm
+
+    inner = _compiled_pipeline(
+        n_batches, n_head + n_r, nwin, interpret=interpret, tile=tile,
+        win_chunk=win_chunk, body="rolled", dwire="plain",
+        tables_in=True, tables_batch=n_batches)
+
+    def f(digits, head_tables, rwire):
+        digits, tables = _msm.assemble_tables_operands(
+            digits, head_tables, rwire, n_batches, dwire)
+        return inner(digits, tables)
+
+    return jax.jit(f)
+
+
+def pallas_window_sums_many_tables(digits, head_tables, rwire,
+                                   interpret: bool = False,
+                                   tile=(SUBLANES, LANES),
+                                   win_chunk: int | None = None):
+    """Production tables-resident dispatch (TPU backends; the XLA twin
+    is msm._compiled_tables_dispatch): digits (B, PACKED_WINDOWS|nwin,
+    N), head_tables the resident (9, 4, NLIMBS, n_head) int16 device
+    array, rwire (B, 33, n_r) compressed R encodings."""
+    from .msm import digit_wire_of, logical_windows
+
+    nwin = logical_windows(digits)
+    if win_chunk is None:
+        win_chunk = _auto_win_chunk(nwin)
+    return _compiled_tables_pipeline(
+        rwire.shape[0], head_tables.shape[-1], rwire.shape[-1], nwin,
+        interpret=interpret, tile=tile, win_chunk=win_chunk,
+        dwire=digit_wire_of(digits))(digits, head_tables, rwire)
 
 
 def pallas_window_sums(digits, points, interpret: bool = False,

@@ -111,7 +111,8 @@ class RoutingPolicy:
                  per_term_s: float = None,
                  min_devices: int = 2,
                  auto_mesh: bool = None,
-                 hot_scale: float = None):
+                 hot_scale: float = None,
+                 tables_hot_scale: float = None):
         # Env overrides come through the config.py registry: a
         # malformed ED25519_TPU_MESH_* value raises a typed ConfigError
         # HERE, at policy construction — not a bare ValueError (or a
@@ -134,9 +135,13 @@ class RoutingPolicy:
         self.hot_scale = (float(hot_scale) if hot_scale is not None
                           else _config.get(
                               "ED25519_TPU_DEVCACHE_HOT_SCALE"))
+        self.tables_hot_scale = (
+            float(tables_hot_scale) if tables_hot_scale is not None
+            else _config.get("ED25519_TPU_DEVCACHE_TABLES_HOT_SCALE"))
 
     def crossover_terms(self, n_devices: int,
-                        devcache_hot: bool = False) -> float:
+                        devcache_hot: bool = False,
+                        tables_hot: bool = False) -> float:
         """N*(D) — the per-batch term count above which a D-device
         sharded dispatch beats the single device.  Infinite for D <= 1
         (sharding over one device can only add collective overhead).
@@ -146,20 +151,28 @@ class RoutingPolicy:
         dispatched keyset is device-resident the per-call staging/H2D
         share of `a` shrinks (the head points never cross the link), so
         the effective crossover LOWERS — sharding starts paying off at
-        smaller batches.  A COLD keyset (devcache_hot=False, the
-        default) uses the unscaled r5 model, bit-for-bit the pre-cache
-        behavior."""
+        smaller batches.  `tables_hot` scales the per-TERM cost `b` by
+        `tables_hot_scale` (ED25519_TPU_DEVCACHE_TABLES_HOT_SCALE):
+        resident multiples tables remove the in-kernel table build —
+        per-term ON-CHIP work — so `b` shrinks and the crossover RISES
+        (cheaper terms need a bigger batch before sharding pays).  A
+        COLD keyset (both False, the default) uses the unscaled r5
+        model, bit-for-bit the pre-cache behavior."""
         if n_devices <= 1:
             return float("inf")
         a = self.fixed_cost_s
         if devcache_hot:
             a *= self.hot_scale
-        return a / (self.per_term_s * (1.0 - 1.0 / n_devices))
+        b = self.per_term_s
+        if tables_hot:
+            b *= self.tables_hot_scale
+        return a / (b * (1.0 - 1.0 / n_devices))
 
     def choose_mesh(self, est_terms_per_batch: int,
                     n_devices: int = None,
                     health: "_health.DeviceHealth | None" = None,
-                    devcache_hot: bool = False) -> int:
+                    devcache_hot: bool = False,
+                    tables_hot: bool = False) -> int:
         """The dispatch mode for batches of ~`est_terms_per_batch` device
         terms: the full available mesh D when sharding clears N*(D) AND
         the mesh's live health allows the device, else 0 (single-device
@@ -175,7 +188,7 @@ class RoutingPolicy:
         if d < self.min_devices:
             return 0
         if est_terms_per_batch <= self.crossover_terms(
-                d, devcache_hot=devcache_hot):
+                d, devcache_hot=devcache_hot, tables_hot=tables_hot):
             return 0
         h = health if health is not None else _health.health_for(d)
         if not h.device_allowed():

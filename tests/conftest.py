@@ -41,6 +41,92 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# -- tier-1 per-file time budget (ROADMAP item 5, round 8) -----------------
+#
+# The committed artifact tests/data/tier1_budget.json pins each test
+# FILE's share of the tier-1 (-m 'not slow') session wall time.  Shares,
+# not seconds: CI runners and the dev box differ 2-3× in absolute speed,
+# but a file silently growing from 5% to 20% of the session is a
+# regression on every machine.  ED25519_TPU_TIER1_BUDGET=1 arms the
+# check (the CI test job's quick run); a file exceeding its budgeted
+# share by the slack factor fails the session loudly.  Regenerate after
+# intentional changes with ED25519_TPU_TIER1_BUDGET_WRITE=1 and commit
+# the diff — the reviewer sees the window impact alongside the code.
+
+_FILE_TIMES: "dict[str, float]" = {}
+_BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "tier1_budget.json")
+_BUDGET_SLACK = 1.6       # measured share may exceed budget share by this
+_BUDGET_ABS_GRACE = 0.02  # ...plus 2% of the session (tiny-file noise)
+_BUDGET_NEW_FILE_SHARE = 0.05  # unbudgeted files may take up to 5%
+
+
+def pytest_runtest_logreport(report):
+    f = report.nodeid.split("::", 1)[0]
+    _FILE_TIMES[f] = _FILE_TIMES.get(f, 0.0) + (report.duration or 0.0)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+    import sys
+
+    total = sum(_FILE_TIMES.values())
+    if os.environ.get("ED25519_TPU_TIER1_BUDGET_WRITE"):
+        artifact = {
+            "note": "tier-1 per-file wall-time budget (shares of the "
+                    "-m 'not slow' session; conftest.py enforces under "
+                    "ED25519_TPU_TIER1_BUDGET=1)",
+            "total_seconds": round(total, 1),
+            "files": {f: round(t, 2)
+                      for f, t in sorted(_FILE_TIMES.items())},
+        }
+        with open(_BUDGET_PATH, "w", encoding="utf-8") as fh:
+            json.dump(artifact, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ntier1-budget: wrote {_BUDGET_PATH} "
+              f"({total:.0f}s over {len(_FILE_TIMES)} files)",
+              file=sys.stderr)
+        return
+    if not os.environ.get("ED25519_TPU_TIER1_BUDGET"):
+        return
+    if not os.path.exists(_BUDGET_PATH) or total <= 0:
+        print("\ntier1-budget: no committed budget artifact "
+              f"({_BUDGET_PATH}) — run with "
+              "ED25519_TPU_TIER1_BUDGET_WRITE=1 to create it",
+              file=sys.stderr)
+        session.exitstatus = 1
+        return
+    with open(_BUDGET_PATH, encoding="utf-8") as fh:
+        budget = json.load(fh)
+    btotal = max(1e-9, float(budget.get("total_seconds", 0)) or
+                 sum(budget["files"].values()))
+    failures = []
+    for f, t in sorted(_FILE_TIMES.items()):
+        share = t / total
+        b = budget["files"].get(f)
+        if b is None:
+            if share > _BUDGET_NEW_FILE_SHARE:
+                failures.append(
+                    f"{f}: {share:.1%} of the session ({t:.1f}s) but "
+                    f"absent from the committed budget — add it "
+                    f"(ED25519_TPU_TIER1_BUDGET_WRITE=1) so the window "
+                    f"cost is reviewed")
+            continue
+        allowed = (b / btotal) * _BUDGET_SLACK + _BUDGET_ABS_GRACE
+        if share > allowed:
+            failures.append(
+                f"{f}: {share:.1%} of the session ({t:.1f}s) vs "
+                f"budgeted {b / btotal:.1%} (allowed ≤ {allowed:.1%}) — "
+                f"tier-1 window regression (ROADMAP item 5)")
+    if failures:
+        print("\ntier1-budget: FAILED\n  " + "\n  ".join(failures),
+              file=sys.stderr)
+        session.exitstatus = 1
+    else:
+        print(f"\ntier1-budget: ok ({total:.0f}s, "
+              f"{len(_FILE_TIMES)} files within the committed shares)",
+              file=sys.stderr)
+
 
 @pytest.fixture(autouse=True, scope="session")
 def _lock_order_audit_at_session_end():

@@ -614,19 +614,22 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 20 knobs (16 through the
-    round-7 operand cache + the four multi-tenancy knobs: the devcache
-    tenant quota, the two class watermarks, and the traffic-lab
-    seed)."""
+    these rows) and the registry knows all 23 knobs (20 through the
+    round-7/8 tenancy work + the three round-8 kernel knobs: the
+    resident-tables opt-out, the tables-hot per-term routing scale,
+    and the shared-pad lane floor)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 20
+    assert len(rows) == len(config.KNOBS) == 23
     assert all(doc for (_, _, _, doc) in rows)
     for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
                  "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
                  "ED25519_TPU_CLASS_WATERMARK_RPC",
-                 "ED25519_TPU_TRAFFIC_LAB_SEED"):
+                 "ED25519_TPU_TRAFFIC_LAB_SEED",
+                 "ED25519_TPU_DEVCACHE_TABLES",
+                 "ED25519_TPU_DEVCACHE_TABLES_HOT_SCALE",
+                 "ED25519_TPU_MIN_LANES"):
         assert name in config.KNOBS
 
 

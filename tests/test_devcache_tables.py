@@ -1,0 +1,328 @@
+"""Verdict transparency of RESIDENT MULTIPLES TABLES (devcache
+kind="tables", round 8 / ISSUE 7).
+
+The consensus rule under test is the same as the head-operand cache's:
+TABLE RESIDENCY IS NEVER VERDICT-RELEVANT.  A resident table is
+hash-pinned to host-built exact multiples; every hit re-hashes the host
+mirror; every degradation — miss, stale epoch (global or tenant),
+corruption, quota refusal, lane death — falls back one rung (the
+head-resident dispatch, then cold staging) and the kernel's group math
+is exact either way, so forced-device verdicts must be bit-identical to
+the pure host oracle on every path, on the consensus-critical
+small-order matrix subset as well as ordinary batches, single-device
+and on the virtual 8-device mesh (where the tables path deliberately
+does not engage).  Mirrors tests/test_devcache.py."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import batch, devcache, faults, health
+from ed25519_consensus_tpu.ops import limbs
+
+jax = pytest.importorskip("jax")
+
+import test_devcache as tdc  # noqa: E402  (shared workload builders)
+
+rng = random.Random(0xDE7CAC)
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    """Fresh injected cache per test (the test_devcache idiom; see that
+    fixture's docstring for the EMA-prior rationale)."""
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 26,
+                                        enabled=True)
+    devcache.set_default_cache(cache)
+    yield cache
+    faults.uninstall()
+    devcache.set_default_cache(None)
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+
+
+# -- unit semantics --------------------------------------------------------
+
+def test_tables_kind_is_independent_entry_with_hash_pinning(reset_state):
+    cache = reset_state
+    d = devcache.keyset_digest(b"\x07" * 32)
+    head = np.arange(4 * 20 * 4, dtype=np.int16).reshape(4, 20, 4)
+    tables = np.arange(9 * 4 * 20 * 4, dtype=np.int16).reshape(
+        9, 4, 20, 4)
+    cache.build(d, 1, head)
+    te = cache.build(d, 1, tables, kind=devcache.KIND_TABLES)
+    assert te is not None and te.kind == devcache.KIND_TABLES
+    assert te.n_head == 4
+    # two entries, ONE keyset
+    st = cache.stats()
+    assert st["resident_keysets"] == 1
+    assert st["resident_entries"] == 2 and st["resident_tables"] == 1
+    # kinds look up independently...
+    assert cache.lookup(d) is not None
+    assert cache.lookup(d, kind=devcache.KIND_TABLES) is te
+    # ...probe exposes both temperatures...
+    pr = cache.probe(d)
+    assert pr["hit"] and pr["tables_hit"]
+    # ...and the tables entry is hash-pinned to its exact bytes.
+    assert te.recheck()
+    te.head_tensor[0, 0, 0, 0] ^= 1
+    assert not te.recheck()
+    # a poisoned mirror never serves: the lookup drops it
+    assert cache.lookup(d, kind=devcache.KIND_TABLES) is None
+    assert cache.counters["restage_hash_mismatch"] >= 1
+    assert not cache.probe(d)["tables_hit"]
+    assert cache.probe(d)["hit"]  # head residency untouched
+
+
+def test_probe_tables_hit_requires_reachable_dispatch(reset_state,
+                                                      monkeypatch):
+    """probe()["tables_hit"] is a ROUTING input (it raises N*), so it
+    must be True only when the tables dispatch is actually reachable:
+    head entry hot too, and the knob on.  A surviving tables entry
+    whose head was evicted — or a disabled knob — probes cold."""
+    cache = reset_state
+    d = devcache.keyset_digest(b"\x0a" * 32)
+    cache.build(d, 1, np.zeros((9, 4, 20, 4), np.int16),
+                kind=devcache.KIND_TABLES)
+    # tables resident, head NOT: the dispatch would stage cold
+    assert not cache.probe(d)["tables_hit"]
+    cache.build(d, 1, np.zeros((4, 20, 4), np.int16))
+    assert cache.probe(d)["tables_hit"]
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE_TABLES", "0")
+    assert not cache.probe(d)["tables_hit"]
+    assert cache.probe(d)["hit"]  # head temperature unaffected
+
+
+def test_can_admit_tables_models_build_refusals(reset_state):
+    """The staging-path pre-check must mirror build()'s refusal rules
+    AND require head+tables co-residency — a budget in the
+    [9x, 10x)-head window (where admitting tables would LRU-evict the
+    same digest's head, thrashing forever) refuses up front, as does a
+    quota-armed budget crowded by other tenants."""
+    head = np.zeros((4, 20, 4), np.int16)
+    tbl_bytes = 9 * head.nbytes
+    d = devcache.keyset_digest(b"\x0b" * 32)
+    # the thrash window: tables alone fit, head + tables do not
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=tbl_bytes + head.nbytes // 2, enabled=True)
+    cache.build(d, 1, head)
+    assert not cache.can_admit_tables(d, tbl_bytes)
+    # pair fits: admitted, and the build must keep the head resident
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=10 * head.nbytes, enabled=True)
+    cache.build(d, 1, head)
+    assert cache.can_admit_tables(d, tbl_bytes)
+    cache.build(d, 1, np.zeros((9, 4, 20, 4), np.int16),
+                kind=devcache.KIND_TABLES)
+    pr = cache.probe(d)
+    assert pr["hit"] and pr["tables_hit"]
+    # quota oversubscription: other tenants crowd the global budget
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=10 * head.nbytes, enabled=True,
+        tenant_quota_bytes=10 * head.nbytes)
+    d_other = devcache.keyset_digest(b"\x0c" * 32)
+    cache.assign_tenant(d_other, "chain-other")
+    cache.build(d_other, 1, np.zeros((4, 20, 8), np.int16))  # 2x head
+    cache.assign_tenant(d, "chain-q")
+    cache.build(d, 1, head)
+    assert not cache.can_admit_tables(d, tbl_bytes)
+    # ...and build() agrees (the authority the pre-check mirrors)
+    assert cache.build(d, 1, np.zeros((9, 4, 20, 4), np.int16),
+                       kind=devcache.KIND_TABLES) is None
+
+
+def test_epoch_bump_stales_tables_like_heads(reset_state):
+    cache = reset_state
+    d = devcache.keyset_digest(b"\x08" * 32)
+    cache.build(d, 1, np.zeros((4, 20, 4), np.int16))
+    cache.build(d, 1, np.zeros((9, 4, 20, 4), np.int16),
+                kind=devcache.KIND_TABLES)
+    cache.bump_epoch("test")
+    assert cache.lookup(d, kind=devcache.KIND_TABLES) is None
+    assert cache.lookup(d) is None
+    assert cache.counters["stale_epoch"] >= 2
+
+
+def test_staged_tables_tensor_matches_device_builder(reset_state):
+    """`StagedBatch.head_tables_tensor()` (the host-exact build the
+    cache pins) and `msm.build_multiples_tables` (the device builder)
+    must describe the SAME group elements column for column — the
+    byte-level representations may differ (canonical vs carry-
+    normalized limbs), the group elements may not."""
+    from ed25519_consensus_tpu.ops import msm
+
+    staged = tdc.recurring_verifier(b"tbl-eq")._stage(rng)
+    head = staged.head_tensor()
+    host_t = staged.head_tables_tensor()
+    dev_t = np.asarray(msm.build_multiples_tables(head[None]))[0]
+    assert host_t.shape == dev_t.shape == (
+        9, 4, limbs.NLIMBS, head.shape[-1])
+    for j in range(head.shape[-1]):
+        for k in range(9):
+            assert (limbs.unpack_point(host_t[k][..., j])
+                    == limbs.unpack_point(dev_t[k][..., j])), (k, j)
+
+
+# -- verdict transparency: the hot path ------------------------------------
+
+def test_recurring_keyset_serves_tables_verdicts_identical(reset_state):
+    """The consensus stream shape through the TABLES path: sight 1
+    cold, sight 2 builds head + tables residency, sight 3+ dispatches
+    through the tables kernel — every rep's forced-device verdicts
+    equal the host oracle bit-for-bit, False verdicts included."""
+    cache = reset_state
+    saw_tables = False
+    for rep in range(5):
+        bad = rep in (1, 4)
+        vs = [tdc.recurring_verifier(b"t%d" % rep, bad=bad),
+              tdc.recurring_verifier(b"t%d-b" % rep)]
+        hv = tdc.host_verdicts(
+            [tdc.recurring_verifier(b"t%d" % rep, bad=bad),
+             tdc.recurring_verifier(b"t%d-b" % rep)])
+        assert tdc.run_forced_device(vs) == hv == [not bad, True]
+        dc = batch.last_run_stats["devcache"]
+        if rep >= 2:
+            assert dc["tables_hit"], f"rep {rep}: tables not resident"
+            assert dc["table_dispatch_hits"] > 0
+        saw_tables |= dc["table_dispatch_hits"] > 0
+    assert saw_tables
+    st = cache.stats()
+    assert st["resident_tables"] == 1 and st["resident_keysets"] == 1
+
+
+def test_small_order_matrix_through_tables_path(reset_state):
+    """The conformance-matrix subset dispatched from resident tables:
+    cold, build, tables-hit — all three verdict vectors identical to
+    the host oracle (all-valid under ZIP215)."""
+    cache = reset_state
+    hv = tdc.host_verdicts([tdc.matrix_verifier()])
+    assert hv == [True]
+    for rep in range(3):
+        assert tdc.run_forced_device([tdc.matrix_verifier()]) == hv
+    assert batch.last_run_stats["devcache"]["table_dispatch_hits"] > 0
+    assert cache.stats()["resident_tables"] == 1
+
+
+def test_tables_knob_off_keeps_head_path(reset_state, monkeypatch):
+    """ED25519_TPU_DEVCACHE_TABLES=0: no tables entries are ever
+    built; the head-resident dispatch (round 7 behavior) carries the
+    stream, verdicts unchanged."""
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE_TABLES", "0")
+    cache = reset_state
+    for rep in range(3):
+        vs = [tdc.recurring_verifier(b"off%d" % rep)]
+        assert tdc.run_forced_device(vs) == [True]
+    dc = batch.last_run_stats["devcache"]
+    assert dc["dispatch_hits"] > 0
+    assert dc["table_dispatch_hits"] == 0
+    assert cache.stats()["resident_tables"] == 0
+
+
+# -- verdict transparency: fault + degradation paths -----------------------
+
+def _faulted_tables_run(kind, reps=4, window=(2, 4)):
+    """Warm tables residency (two sights), then drive the stream with a
+    devcache fault plan over the lookup seam — which now carries BOTH
+    kinds' lookups — asserting host-identical verdicts throughout."""
+    for rep in range(2):
+        assert tdc.run_forced_device(
+            [tdc.recurring_verifier(b"w%d" % rep)]) == [True]
+    plan = faults.devcache_plan(seed=0xD8, kind=kind, at=window[0] - 2,
+                                length=window[1] - window[0])
+    with faults.injected(plan):
+        for rep in range(reps):
+            bad = rep == 1
+            vs = [tdc.recurring_verifier(b"f%d" % rep, bad=bad)]
+            hv = tdc.host_verdicts(
+                [tdc.recurring_verifier(b"f%d" % rep, bad=bad)])
+            assert tdc.run_forced_device(vs) == hv == [not bad]
+    assert plan.calls_seen(faults.SITE_DEVCACHE) >= 1
+
+
+def test_corrupt_resident_tables_restage_never_a_verdict(reset_state):
+    """Injected host-mirror corruption at the lookup seam (the seam
+    carries head AND tables lookups): the per-hit hash re-check
+    catches whichever entry rots, the dispatch degrades a rung, and
+    verdicts stay host-identical."""
+    cache = reset_state
+    _faulted_tables_run("corrupt")
+    assert cache.counters["restage_hash_mismatch"] >= 1
+
+
+def test_stale_epoch_on_tables_restages(reset_state):
+    """An epoch bump between staging and dispatch stales the tables
+    entry exactly like a head entry; the stream rebuilds residency
+    under the new epoch with verdicts unchanged."""
+    cache = reset_state
+    _faulted_tables_run("stale")
+    assert cache.counters["stale_epoch"] >= 1
+    assert cache.epoch >= 1
+
+
+def test_tables_quota_refused_leaves_head_resident(reset_state):
+    """Cache QoS: a tenant quota sized for the head tensor but not the
+    9× tables tensor refuses the tables build (counted), leaves the
+    head entry untouched, and the stream keeps verifying host-
+    identically from the head-resident dispatch."""
+    staged = tdc.recurring_verifier(b"qr")._stage(rng)
+    head_bytes = staged.head_tensor().nbytes
+    cache = devcache.DeviceOperandCache(
+        budget_bytes=1 << 26, enabled=True,
+        tenant_quota_bytes=4 * head_bytes)  # head fits, 9× tables not
+    devcache.set_default_cache(cache)
+    d = devcache.keyset_digest(staged.keyset_blob)
+    cache.assign_tenant(d, "chain-q")
+    # cache-level refusal: the authority check (batch.py's byte
+    # pre-check merely avoids paying the host build for this outcome)
+    cache.build(d, len(staged.coeffs) - 1, staged.head_tensor())
+    assert cache.build(d, len(staged.coeffs) - 1,
+                       staged.head_tables_tensor(),
+                       kind=devcache.KIND_TABLES) is None
+    assert cache.counters["quota_rejected"] >= 1
+    assert cache.probe(d)["hit"] and not cache.probe(d)["tables_hit"]
+    # end-to-end: the stream serves from head residency, never tables
+    for rep in range(3):
+        assert tdc.run_forced_device(
+            [tdc.recurring_verifier(b"qr%d" % rep)]) == [True]
+    dc = batch.last_run_stats["devcache"]
+    assert dc["table_dispatch_hits"] == 0
+    assert cache.stats()["resident_tables"] == 0
+
+
+def test_lane_death_drops_tables_residency(reset_state):
+    cache = reset_state
+    d = devcache.keyset_digest(b"ld" * 16)
+    cache.build(d, 1, np.zeros((4, 20, 4), np.int16))
+    cache.build(d, 1, np.zeros((9, 4, 20, 4), np.int16),
+                kind=devcache.KIND_TABLES)
+    assert cache.stats()["resident_entries"] == 2
+    h = health.DeviceHealth(clock=health.FakeClock())
+    h.mark_lane_stuck()
+    assert cache.stats()["resident_entries"] == 0
+
+
+# -- the mesh lane ---------------------------------------------------------
+
+def test_mesh_keeps_head_dispatch_verdicts_identical(reset_state):
+    """The 8-virtual-device mesh: the tables path is single-device only
+    (round 8) — the mesh lane must keep serving the head-resident
+    sharded dispatch with host-identical verdicts, tables residency
+    present or not."""
+    tdc._require_devices(8)
+    cache = reset_state
+    saw_hit = False
+    for rep in range(4):
+        bad = rep == 2
+        vs = [tdc.recurring_verifier(b"m%d" % rep, bad=bad),
+              tdc.recurring_verifier(b"m%d-b" % rep)]
+        hv = tdc.host_verdicts(
+            [tdc.recurring_verifier(b"m%d" % rep, bad=bad),
+             tdc.recurring_verifier(b"m%d-b" % rep)])
+        assert tdc.run_forced_device(vs, mesh=8) == hv == [not bad, True]
+        dc = batch.last_run_stats["devcache"]
+        assert dc["table_dispatch_hits"] == 0  # single-device only
+        saw_hit |= dc["dispatch_hits"] > 0
+    assert saw_hit
+    assert cache.counters["hits"] >= 1

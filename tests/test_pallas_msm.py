@@ -63,6 +63,37 @@ def test_digit_nibble_packing_roundtrip():
     assert np.array_equal(back, planes)
 
 
+def _digits_value_radix(planes, col, radix):
+    val = 0
+    for w in range(planes.shape[0]):
+        val = radix * val + int(planes[w, col])
+    return val
+
+
+def test_signed_recode_radix32_roundtrip():
+    """Round-8 radix-32 recoding (ISSUE 7 variant sweep): 27 MSB-first
+    signed 5-bit planes, digits in [-16, 15] (so the kernel's 17-entry
+    [0..16]P table covers every |digit|), recombining to the exact
+    scalar — including the carry-chain worst cases."""
+    cases = [0, 1, 15, 16, 17, 31, 32,
+             0x8421084210842108421084210842108,  # alternating digits
+             (1 << 128) - 1, (1 << 128) - 16]
+    cases += [rng.randrange(1 << 128) for _ in range(64)]
+    planes = limbs.pack_scalar_windows(cases, limbs.NWINDOWS_R32,
+                                       limbs.WINDOW_BITS_R32)
+    assert planes.dtype == np.int8
+    assert planes.shape == (limbs.NWINDOWS_R32, len(cases))
+    assert int(planes.min()) >= -16 and int(planes.max()) <= 15
+    for j, c in enumerate(cases):
+        assert _digits_value_radix(planes, j, 32) == c, hex(c)
+    # the production radix-16 packing is untouched by the
+    # generalization: default args reproduce the historical planes
+    p16 = limbs.pack_scalar_windows(cases)
+    assert p16.shape == (limbs.NWINDOWS, len(cases))
+    for j, c in enumerate(cases):
+        assert _digits_value_radix(p16, j, 16) == c, hex(c)
+
+
 def test_u128_window_packing_matches_scalar_packing():
     zs = [rng.randrange(1 << 128) for _ in range(40)] + [0, 1, (1 << 128) - 1]
     zb = np.frombuffer(

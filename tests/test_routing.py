@@ -122,10 +122,9 @@ def test_verify_many_auto_selects_mesh_above_crossover():
     # through a policy wrapper to keep the virtual-mesh compile small.
     class TwoDevicePolicy(routing.RoutingPolicy):
         def choose_mesh(self, est, n_devices=None, health=None,
-                        devcache_hot=False):
+                        **temps):  # devcache_hot / tables_hot
             return super().choose_mesh(est, n_devices=mesh_d,
-                                       health=health,
-                                       devcache_hot=devcache_hot)
+                                       health=health, **temps)
 
     pol2 = TwoDevicePolicy(fixed_cost_s=1e-9, per_term_s=1.0,
                            min_devices=2)
@@ -239,9 +238,36 @@ def test_hot_keyset_lowers_crossover():
         flat.crossover_terms(8)
 
 
+def test_resident_tables_raise_crossover():
+    """Round 8: resident multiples TABLES scale the per-TERM cost b by
+    tables_hot_scale — cheaper on-chip terms need a BIGGER batch before
+    the mesh's fixed collective cost pays off, so N* rises by exactly
+    1/tables_hot_scale.  Cold tables (the default) are bit-identical to
+    the round-7 model, and 1.0 disables the effect."""
+    pol = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6,
+                                hot_scale=0.75, tables_hot_scale=0.75)
+    h = health.DeviceHealth(mesh=8, clock=health.FakeClock())
+    cold = pol.crossover_terms(8)
+    tables_hot = pol.crossover_terms(8, tables_hot=True)
+    assert tables_hot == pytest.approx(cold / 0.75)
+    assert pol.crossover_terms(8, tables_hot=False) == cold
+    # both temperatures compose: a/b scale independently
+    both = pol.crossover_terms(8, devcache_hot=True, tables_hot=True)
+    assert both == pytest.approx(cold * 0.75 / 0.75)
+    between = int((cold + tables_hot) / 2)
+    assert pol.choose_mesh(between, n_devices=8, health=h) == 8
+    assert pol.choose_mesh(between, n_devices=8, health=h,
+                           tables_hot=True) == 0
+    flat = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6,
+                                 tables_hot_scale=1.0)
+    assert flat.crossover_terms(8, tables_hot=True) == \
+        flat.crossover_terms(8)
+
+
 def test_stats_report_devcache_probe(fast_device):
     """last_run_stats carries the cache-temperature input the routing
-    decision consumed: {"hit": bool, "resident_bytes": int} plus the
+    decision consumed: {"hit": bool, "tables_hit": bool,
+    "resident_bytes": int} plus the
     dispatch-hit count — auditable per call."""
     from ed25519_consensus_tpu import devcache
 
@@ -251,8 +277,10 @@ def test_stats_report_devcache_probe(fast_device):
         vs = make_verifiers(3)
         batch.verify_many(vs, rng=rng, chunk=2, merge="never")
         dc = batch.last_run_stats["devcache"]
-        assert set(dc) == {"hit", "resident_bytes", "dispatch_hits"}
+        assert set(dc) == {"hit", "tables_hit", "resident_bytes",
+                           "dispatch_hits", "table_dispatch_hits"}
         assert dc["hit"] is False  # cold cache
+        assert dc["tables_hit"] is False
         assert dc["resident_bytes"] == 0
     finally:
         devcache.set_default_cache(None)

@@ -106,6 +106,10 @@ def main():
             ("variant-hybrid", dict(body="hybrid")),
             ("variant-tbl-int32", dict(tbl_dtype="int32")),
             ("variant-win-chunk2", dict(win_chunk=2)),
+            # round-8 sweep variants (ISSUE 7): the narrow fold
+            # accumulator, and a win_chunk beyond the old ≤3 auto cap
+            # on the full-width planes (11 | 33)
+            ("variant-int16-fold", dict(fold_dtype="int16")),
         ):
             out = np.asarray(
                 pallas_msm.pallas_window_sums_many(
@@ -117,6 +121,50 @@ def main():
             verdicts.append(
                 f"{label}:{'MATCH' if got == want else 'MISMATCH'}"
             )
+        out = np.asarray(
+            pallas_msm.pallas_window_sums_many(
+                dig_w[None], packed_w[None], interpret=True, tile=tile,
+                win_chunk=11,
+            )
+        )
+        got = msm.combine_window_sums(out)
+        verdicts.append(
+            f"variant-win-chunk11:"
+            f"{'MATCH' if got == want_wide else 'MISMATCH'}"
+        )
+        # radix-32: 27 signed 5-bit planes against the 17-entry table —
+        # its own recoding, table build, select range, and Horner
+        # radix, pinned on the full-width scalars
+        dig_r32, packed_r32 = msm.pack_msm_operands(
+            sc_wide, pts, n_lanes=pallas_msm.pad_lanes(n, group),
+            window_bits=5,
+        )
+        out = np.asarray(
+            pallas_msm.pallas_window_sums_many(
+                dig_r32[None], packed_r32[None], interpret=True,
+                tile=tile, window_bits=5, win_chunk=9,
+            )
+        )
+        got = msm.combine_window_sums(out, window_bits=5)
+        verdicts.append(
+            f"variant-radix32:"
+            f"{'MATCH' if got == want_wide else 'MISMATCH'}"
+        )
+        # tables-ref: full prebuilt multiples tables (the resident-
+        # tables kernel variant) — table bytes from the XLA builder,
+        # shared across the batch axis (tables_batch=1), kernel skips
+        # stage 1 entirely
+        tbl = np.asarray(msm.build_multiples_tables(packed_w[None]))
+        out = np.asarray(
+            pallas_msm.pallas_window_sums_many_tables_full(
+                dig_w[None], tbl[:1], interpret=True, tile=tile,
+            )
+        )
+        got = msm.combine_window_sums(out)
+        verdicts.append(
+            f"variant-tables-ref:"
+            f"{'MATCH' if got == want_wide else 'MISMATCH'}"
+        )
     verdict = " ".join(verdicts)
     print(f"INTERP_PARITY {backend} {verdict}")
     sys.stdout.flush()

@@ -21,8 +21,10 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 import numpy as np  # noqa: E402
 
 
-def build_operands(n_lanes, B=1, seed=7):
-    """Random-ish valid operands: basepoint multiples + random digits."""
+def build_operands(n_lanes, B=1, seed=7, window_bits=4):
+    """Random-ish valid operands: basepoint multiples + random digits.
+    `window_bits=5` packs the radix-32 digit planes (27 planes,
+    17-entry table) for the round-8 variant sweep."""
     import random
 
     from ed25519_consensus_tpu.ops import edwards, msm
@@ -33,7 +35,8 @@ def build_operands(n_lanes, B=1, seed=7):
            for _ in range(min(n, 64))]
     pts = [pts[i % len(pts)] for i in range(n)]
     sc = [rng.randrange(2**128) for _ in range(n)]
-    digits, packed = msm.pack_msm_operands(sc, pts, n_lanes=n_lanes)
+    digits, packed = msm.pack_msm_operands(sc, pts, n_lanes=n_lanes,
+                                           window_bits=window_bits)
     if B > 1:
         digits = np.broadcast_to(digits, (B,) + digits.shape).copy()
         packed = np.broadcast_to(packed, (B,) + packed.shape).copy()
@@ -53,10 +56,11 @@ def timed_calls(fn, digits, pts, reps=7):
     return ts[len(ts) // 2]
 
 
-def check_parity(out, sc, pts, label):
+def check_parity(out, sc, pts, label, window_bits=4):
     from ed25519_consensus_tpu.ops import edwards, msm
 
-    got = msm.combine_window_sums(np.asarray(out)[:1])
+    got = msm.combine_window_sums(np.asarray(out)[:1],
+                                  window_bits=window_bits)
     want = edwards.multiscalar_mul(sc, pts)
     ok = got == want
     print(f"#   parity[{label}]: {'OK' if ok else 'MISMATCH'}", flush=True)
@@ -128,9 +132,99 @@ def exp_variant(name, **kw):
           flush=True)
 
 
+def exp_sweep(chunk_b=8, n_lanes=12288, out_path=None):
+    """The round-8 VARIANT SWEEP (ISSUE 7): time every candidate kernel
+    variant at the production dispatch shape, parity-gate each against
+    the exact host MSM, and report the fastest `parity: OK` one as the
+    selection.  A variant that fails compile OR parity is disqualified,
+    never selected — exactly the bench driver's hardware_parity rule.
+    Pinning the winner = setting its env knobs (printed) and
+    regenerating the jaxpr manifest (`tools/consensuslint.py --ir-audit
+    --write-manifest`), which the static-analysis job then enforces;
+    every candidate below is already in the ir_audit variant matrix."""
+    import json
+
+    from ed25519_consensus_tpu.ops import msm, pallas_msm
+
+    sc, pts, digits, packed = build_operands(n_lanes, B=chunk_b)
+    sc32, pts32, digits32, packed32 = build_operands(
+        n_lanes, B=chunk_b, window_bits=5)
+    tables = None
+
+    def tables_full():
+        nonlocal tables
+        if tables is None:
+            tables = np.asarray(msm.build_multiples_tables(packed[:1]))
+        return tables
+
+    candidates = [
+        # (name, dispatch fn, window_bits, pin — the knobs that select it)
+        ("rolled-w11", lambda: pallas_msm.pallas_window_sums_many(
+            digits, packed, win_chunk=11), 4,
+         {"ED25519_TPU_WIN_CHUNK": "11"}),
+        ("rolled-w33", lambda: pallas_msm.pallas_window_sums_many(
+            digits, packed, win_chunk=33), 4,
+         {"ED25519_TPU_WIN_CHUNK": "33"}),
+        ("int16-fold-w11", lambda: pallas_msm.pallas_window_sums_many(
+            digits, packed, win_chunk=11, fold_dtype="int16"), 4,
+         {"ED25519_TPU_WIN_CHUNK": "11", "fold_dtype": "int16"}),
+        ("radix32-w9", lambda: pallas_msm.pallas_window_sums_many(
+            digits32, packed32, win_chunk=9, window_bits=5), 5,
+         {"window_bits": "5", "ED25519_TPU_WIN_CHUNK": "9"}),
+        ("radix32-w27", lambda: pallas_msm.pallas_window_sums_many(
+            digits32, packed32, win_chunk=27, window_bits=5), 5,
+         {"window_bits": "5", "ED25519_TPU_WIN_CHUNK": "27"}),
+        ("tables-ref-w11",
+         lambda: pallas_msm.pallas_window_sums_many_tables_full(
+             digits, tables_full()[:1], win_chunk=11), 4,
+         {"resident": "devcache tables (ED25519_TPU_DEVCACHE_TABLES)"}),
+    ]
+    results = {}
+    for name, fn, wb, pin in candidates:
+        row = {"pin": pin}
+        try:
+            t0 = time.perf_counter()
+            out = fn()
+            np.asarray(out)
+            row["compile_s"] = round(time.perf_counter() - t0, 1)
+        except Exception as e:  # noqa: BLE001 - disqualify, keep sweeping
+            row["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+            results[name] = row
+            print(f"#   {name}: COMPILE/RUN FAILED {row['error']}",
+                  flush=True)
+            continue
+        scx = sc32 if wb == 5 else sc
+        ptsx = pts32 if wb == 5 else pts
+        row["parity"] = "ok" if check_parity(
+            out, scx, ptsx, name, window_bits=wb) else "fail"
+        t = timed_calls(lambda *_: fn(), None, None)
+        row["ms_per_call"] = round(t * 1e3, 1)
+        row["terms_per_sec"] = round(chunk_b * n_lanes / t, 1)
+        results[name] = row
+        print(f"#   {name}: {row['ms_per_call']} ms/call -> "
+              f"{row['terms_per_sec']:.0f} terms/s "
+              f"(parity {row['parity']})", flush=True)
+    ok_rows = {n: r for n, r in results.items()
+               if r.get("parity") == "ok"}
+    selected = (max(ok_rows, key=lambda n: ok_rows[n]["terms_per_sec"])
+                if ok_rows else None)
+    sweep = {"kernel_sweep": {
+        "shape": [chunk_b, n_lanes],
+        "results": results,
+        "selected": selected,
+        "pin": results[selected]["pin"] if selected else None,
+    }}
+    print(json.dumps(sweep), flush=True)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(sweep, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return sweep
+
+
 _EXPS = ("baseline", "all", "i32", "i32big", "s8", "s8i32", "s16",
          "all8", "w3", "w11", "w11i32", "allw", "rolled", "hybrid",
-         "ab", "rolledB8")
+         "ab", "rolledB8", "sweep")
 
 
 def main():
@@ -138,10 +232,21 @@ def main():
     # choices= so a stale experiment name (e.g. the removed "unrolled"
     # body A/B) errors loudly instead of silently running nothing
     ap.add_argument("--exp", default="baseline", choices=_EXPS)
+    ap.add_argument("--out", default=None,
+                    help="sweep only: also write the kernel_sweep JSON "
+                         "to this path (bench_artifacts/ pin)")
     args = ap.parse_args()
     import jax
 
     print(f"# devices: {jax.devices()}", flush=True)
+    if args.exp == "sweep":
+        if jax.devices()[0].platform == "cpu":
+            print("# sweep: SKIPPED — Mosaic timing requires TPU "
+                  "hardware (variant parity is pinned in interpret "
+                  "mode by tests/test_pallas_msm.py)", flush=True)
+            os._exit(0)
+        exp_sweep(out_path=args.out)
+        os._exit(0)
     if args.exp in ("baseline", "all"):
         exp_baseline()
     if args.exp in ("i32", "all"):

@@ -5,8 +5,19 @@ bandwidth; this isolates WHERE per-op time goes: chained elementwise ops,
 the _fmul schoolbook, a full _padd, and the select pattern — each as a
 standalone kernel, timed by slope between two chain lengths (cancels call
 overhead/RTT).
+
+`--profile-ledger [B N]` (round 8, ISSUE 7): the per-CALL stage
+decomposition of the production MSM dispatch — table-build vs
+window-select vs in-kernel fold vs the XLA cross-block fold — measured
+as DIFFERENCES between real kernel variants at the same shape (full
+kernel − tables-input kernel = table build; tables kernel −
+select-only kernel = in-kernel fold; pipeline − kernel = XLA fold +
+transpose), each a median of reps with a full D2H fetch.  Emits one
+JSON line (`device_program_profile`) that bench.py attaches to the
+driver output.
 """
 
+import json
 import os
 import time
 
@@ -105,10 +116,138 @@ def probe_fmul(tile=(32, 128), n_steps=(1, 8)):
           f"(~1330 tile-ops -> {per/1330*1e9:.0f} ns/tile-op)", flush=True)
 
 
+def profile_ledger(chunk_b: int = 8, n_lanes: int = 12288, reps: int = 5,
+                   win_chunk: int = 11):
+    """The per-stage decomposition of one production-shape MSM dispatch
+    (the `device_program_profile` block).  Four measured forms at the
+    SAME (B, N) shape, coldest path first:
+
+    * full pipeline      — kernel (build+select+fold) + XLA fold; the
+      number `bench.py --config ...` reports as program time.
+    * full kernel only   — the bare pallas_call, no XLA fold.
+    * tables-in kernel   — prebuilt multiples tables; no stage-1 build.
+    * select-only kernel — tables-in with the in-block fold skipped
+      (debug variant; garbage math, honest timing).
+
+    Buckets are differences of those medians, so every bucket is the
+    gap between two REAL executions of the same shape — no analytic
+    modelling.  Returns the ledger dict (also printed as JSON).
+
+    CPU backends return {"skipped": ...}: Mosaic does not run there and
+    an interpret-mode run of this shape is hours — the decomposition is
+    a hardware measurement by nature (the variants' correctness is
+    pinned separately in interpret mode at a shrunken tile)."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        out = {"skipped": "cpu backend: Mosaic profile requires TPU "
+                          "hardware (variants parity-pinned in "
+                          "interpret mode; hardware capture is the "
+                          "follow-up)"}
+        print(json.dumps({"device_program_profile": out}), flush=True)
+        return out
+
+    from ed25519_consensus_tpu.ops import msm, pallas_msm
+    from ed25519_consensus_tpu.ops.limbs import NLIMBS, NWINDOWS
+
+    import kernel_lab  # sibling tool: operand builder
+
+    sc, pts, digits, packed = kernel_lab.build_operands(n_lanes,
+                                                        B=chunk_b)
+    S, Ln = pallas_msm.SUBLANES, pallas_msm.LANES
+    n_blocks = n_lanes // (S * Ln)
+    nwin = NWINDOWS
+
+    def blocked(d, p):
+        dig = d.reshape(chunk_b, nwin, n_blocks, S, Ln)
+        pp = p.reshape(chunk_b, 4, NLIMBS, n_blocks, S, Ln)
+        return dig, pp
+
+    import jax.numpy as jnp  # noqa: F401
+
+    tables = np.asarray(msm.build_multiples_tables(packed))
+    tbl_blocked = tables.reshape(
+        chunk_b, 9, 4, NLIMBS, n_blocks, S, Ln)
+    dig_b, pts_b = blocked(digits, packed)
+
+    forms = {}
+    # full pipeline (what measure_device_program times on-chip)
+    fn_pipe = lambda: pallas_msm.pallas_window_sums_many(  # noqa: E731
+        digits, packed, win_chunk=win_chunk)
+    # bare kernels at the same shape
+    k_full = pallas_msm._compiled_pallas_kernel_rolled(
+        chunk_b, n_blocks, nwin, win_chunk=win_chunk)
+    k_tbl = pallas_msm._compiled_pallas_kernel_rolled(
+        chunk_b, n_blocks, nwin, win_chunk=win_chunk, tables_in=True)
+    k_sel = pallas_msm._compiled_pallas_kernel_rolled(
+        chunk_b, n_blocks, nwin, win_chunk=win_chunk, tables_in=True,
+        select_only=True)
+    import jax as _jax
+
+    j_full = _jax.jit(lambda d, p: k_full(d, p))
+    j_tbl = _jax.jit(lambda d, t: k_tbl(d, t))
+    j_sel = _jax.jit(lambda d, t: k_sel(d, t))
+    for name, fn, args in (
+        ("pipeline_full", None, None),
+        ("kernel_full", j_full, (dig_b, pts_b)),
+        ("kernel_tables", j_tbl, (dig_b, tbl_blocked)),
+        ("kernel_select_only", j_sel, (dig_b, tbl_blocked)),
+    ):
+        t0 = time.perf_counter()
+        if fn is None:
+            np.asarray(fn_pipe())
+            t = timed(lambda: fn_pipe(), reps=reps)
+        else:
+            np.asarray(fn(*args))  # compile
+            t = timed(fn, *args, reps=reps)
+        forms[name] = t
+        print(f"#   {name}: {t*1e3:.1f} ms/call "
+              f"(first+compile {time.perf_counter()-t0:.1f}s)",
+              flush=True)
+    ledger = {
+        "shape": [chunk_b, n_lanes],
+        "win_chunk": win_chunk,
+        "reps": reps,
+        "total_ms": round(forms["pipeline_full"] * 1e3, 2),
+        "kernel_ms": round(forms["kernel_full"] * 1e3, 2),
+        "table_build_ms": round(
+            (forms["kernel_full"] - forms["kernel_tables"]) * 1e3, 2),
+        "select_ms": round(forms["kernel_select_only"] * 1e3, 2),
+        "fold_in_kernel_ms": round(
+            (forms["kernel_tables"] - forms["kernel_select_only"])
+            * 1e3, 2),
+        "xla_fold_ms": round(
+            (forms["pipeline_full"] - forms["kernel_full"]) * 1e3, 2),
+        "terms_per_sec_full": round(
+            chunk_b * n_lanes / forms["pipeline_full"], 1),
+        "terms_per_sec_tables_resident": round(
+            chunk_b * n_lanes
+            / (forms["kernel_tables"]
+               + (forms["pipeline_full"] - forms["kernel_full"])), 1),
+    }
+    print(json.dumps({"device_program_profile": ledger}), flush=True)
+    return ledger
+
+
 def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile-ledger", nargs="*", type=int, default=None,
+                    metavar=("B", "N"),
+                    help="emit the per-stage device_program_profile "
+                         "ledger at shape [B N] (default 8 12288) "
+                         "instead of the micro-probes")
+    args = ap.parse_args()
     import jax
 
     print(f"# devices: {jax.devices()}", flush=True)
+    if args.profile_ledger is not None:
+        shape = args.profile_ledger + [8, 12288][len(args.profile_ledger):]
+        profile_ledger(chunk_b=shape[0], n_lanes=shape[1])
+        os._exit(0)
     probe_chain("add")
     probe_chain("mul")
     probe_chain("madd")
