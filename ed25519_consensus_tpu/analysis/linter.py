@@ -81,7 +81,7 @@ _FLOAT_DTYPES = frozenset(
 # invocation passes it explicitly.)
 _CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
                   "faults.py", "devcache.py", "tenancy.py",
-                  "tools/traffic_lab.py")
+                  "tools/traffic_lab.py", "tools/mesh_chaos.py")
 _CL004_ALLOWED = {
     "batch.py": frozenset((
         "_shift128_cache", "_key_row_cache", "_host_split_cache",
@@ -91,8 +91,13 @@ _CL004_ALLOWED = {
     "service.py": frozenset(("_BREAKER_GAUGE",)),
     "health.py": frozenset(("_lane_stuck_latch", "_registry",
                             # append-only listener wiring (devcache
-                            # residency drop), not cache state
-                            "_residency_listeners")),
+                            # residency/chip drops), not cache state
+                            "_residency_listeners",
+                            "_chip_drop_listeners",
+                            # the process chip-liveness registry
+                            # (round 9): one instance like the
+                            # lane-stuck latch, reset via reset_all
+                            "_chip_registry")),
     "routing.py": frozenset(("_device_count", "_default")),
     "faults.py": frozenset(("_active",)),
     # The device operand cache is an injectable object; ONLY the
@@ -106,7 +111,7 @@ _LOCK_CONSTRUCTORS = frozenset(
      "BoundedSemaphore", "Barrier"))
 
 _CL006_MODULES = ("batch.py", "service.py", "tenancy.py",
-                  "tools/traffic_lab.py")
+                  "tools/traffic_lab.py", "tools/mesh_chaos.py")
 _CL005_SECRET_ATTRS = frozenset(("s", "prefix"))
 _CL005_SECRET_CALLS = frozenset(("to_bytes", "__bytes__"))
 

@@ -1237,26 +1237,31 @@ class _DeviceLane:
 
     @classmethod
     def get(cls, mesh: int = 0,
-            health: "DeviceHealth | None" = None) -> "_DeviceLane":
+            health: "DeviceHealth | None" = None,
+            device_ids: "tuple | None" = None) -> "_DeviceLane":
         mesh = _health.normalize_mesh(mesh)
         if health is None:
             health = _health.health_for(mesh)
+        device_ids = tuple(device_ids) if device_ids else None
         # Two concurrent same-mode callers must not each build a lane.
         with cls._instance_lock:
             inst = cls._instances.get(mesh)
             if inst is not None and inst.healthy() \
-                    and inst._health is not health:
-                # A caller injected a different health/clock (tests):
-                # retire the old worker — its queue drains to the poison
-                # sentinel — and build a lane on the new one.  The
-                # retired lane follows the abandon discipline: marked
-                # unhealthy (never handed out again) and parked in the
-                # side registry so the reset_all drains still join a
-                # worker that is mid-call when retired (an untracked
-                # live worker at interpreter teardown is exactly the
-                # crash the side registry exists to prevent).  NOT
-                # lane_stuck: retirement is not evidence of a wedged
-                # worker; reset_all marks stuck if it refuses to die.
+                    and (inst._health is not health
+                         or inst._device_ids != device_ids):
+                # A caller injected a different health/clock (tests) or
+                # a different surviving-chip placement (degraded-mesh
+                # reformation): retire the old worker — its queue
+                # drains to the poison sentinel — and build a lane on
+                # the new one.  The retired lane follows the abandon
+                # discipline: marked unhealthy (never handed out again)
+                # and parked in the side registry so the reset_all
+                # drains still join a worker that is mid-call when
+                # retired (an untracked live worker at interpreter
+                # teardown is exactly the crash the side registry
+                # exists to prevent).  NOT lane_stuck: retirement is
+                # not evidence of a wedged worker; reset_all marks
+                # stuck if it refuses to die.
                 inst._q.put(None)
                 inst._abandoned = True
                 if inst._thread.is_alive() \
@@ -1264,7 +1269,8 @@ class _DeviceLane:
                     cls._abandoned_instances.append(inst)
                 inst = None
             if inst is None or not inst.healthy():
-                inst = cls(mesh=mesh, health=health)
+                inst = cls(mesh=mesh, health=health,
+                           device_ids=device_ids)
                 cls._instances[mesh] = inst
             return inst
 
@@ -1323,11 +1329,18 @@ class _DeviceLane:
         return all_dead
 
     def __init__(self, mesh: int = 0,
-                 health: "DeviceHealth | None" = None):
+                 health: "DeviceHealth | None" = None,
+                 device_ids: "tuple | None" = None):
         import queue
         import threading
 
         self._mesh = _health.normalize_mesh(mesh)
+        # Degraded-mesh placement (round 9): the surviving chip indices
+        # this lane dispatches on — None is the canonical prefix
+        # (devices 0..mesh−1, or device 0 for the single lane).  Part
+        # of the lane identity: get() retires a lane whose placement no
+        # longer matches the live reformation rung.
+        self._device_ids = tuple(device_ids) if device_ids else None
         self._health = health if health is not None \
             else _health.health_for(self._mesh)
         self._clock = self._health.clock
@@ -1444,6 +1457,12 @@ class _DeviceLane:
                     t_call = clock.monotonic()
                     with self._cv:
                         self._started[cid] = t_call
+                    ids = self._device_ids
+                    # Reformed placement rides as a kwarg ONLY when set:
+                    # the canonical-prefix path keeps the historical
+                    # call shape (tests and tools stub these dispatch
+                    # functions by exact signature).
+                    _idkw = {"device_ids": ids} if ids else {}
                     if cached is not None and self._mesh > 1:
                         from .parallel import sharded_msm as _sh
 
@@ -1452,11 +1471,11 @@ class _DeviceLane:
                         n_batches = dr.shape[0]
 
                         def _call(sh=_sh, dh=dh, dr=dr):
-                            head = cached.device_ref(self._mesh)
+                            head = cached.device_ref(self._mesh, ids)
                             return np.asarray(
                                 sh.sharded_window_sums_many_cached(
                                     dh, dr, head, pts, self._mesh,
-                                    clock=clock))
+                                    clock=clock, **_idkw))
                     elif cached is not None and tables is not None:
                         # Resident-TABLES dispatch (round 8): the head
                         # lanes' multiples tables come from the entry's
@@ -1466,7 +1485,7 @@ class _DeviceLane:
                         n_batches = digits.shape[0]
 
                         def _call():
-                            tbl = tables.device_ref(0)
+                            tbl = tables.device_ref(0, ids)
                             return np.asarray(
                                 _msm.dispatch_window_sums_many_tables(
                                     digits, tbl, pts))
@@ -1475,7 +1494,7 @@ class _DeviceLane:
                         n_batches = digits.shape[0]
 
                         def _call():
-                            head = cached.device_ref(0)
+                            head = cached.device_ref(0, ids)
                             return np.asarray(
                                 _msm.dispatch_window_sums_many_cached(
                                     digits, head, pts))
@@ -1487,7 +1506,8 @@ class _DeviceLane:
 
                         def _call(sh=_sh):
                             return np.asarray(sh.sharded_window_sums_many(
-                                digits, pts, self._mesh, clock=clock))
+                                digits, pts, self._mesh, clock=clock,
+                                **_idkw))
                     else:
                         lanes_key = digits.shape[2]
                         n_batches = digits.shape[0]
@@ -1495,6 +1515,18 @@ class _DeviceLane:
                         def _call():
                             return np.asarray(
                                 _msm.dispatch_window_sums_many(digits, pts))
+                    if ids and self._mesh == 0:
+                        # Reformed single-device rung: chip 0 is dead,
+                        # so the single lane runs on the first SURVIVING
+                        # chip — jax places uncommitted operands on the
+                        # default device, which this context overrides.
+                        import jax as _jax
+
+                        _inner = _call
+
+                        def _call(devs=_jax.devices(), inner=_inner):
+                            with _jax.default_device(devs[ids[0]]):
+                                return inner()
                     # Every device call passes through the fault-injection
                     # seam (a no-op unless a faults.FaultPlan is
                     # installed) — THE place deterministic error/stall/
@@ -1881,6 +1913,28 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # the health object, the shard padding, and the shape-completed
     # grace keys all agree across call sites.
     mesh = _health.normalize_mesh(mesh)
+    # Degraded-mesh clamp (round 9): with chips marked dead in the
+    # process ChipRegistry, the dispatch can only run a rung the LIVE
+    # chip set supports — an explicit mesh=8 on a mesh that lost a
+    # chip runs as the reformed mesh(4) on the survivors, not as a
+    # doomed full-width dispatch.  Zero-cost (one empty-set read) and
+    # behavior-identical while every chip is healthy, auto-routing
+    # included (choose_mesh already resolves to the live rung).
+    device_ids = None
+    entry_reform = None
+    no_device_rung = False
+    if (not _config.get("ED25519_TPU_DISABLE_DEVICE")
+            and _health.chip_registry().dead_chips()):
+        rung, device_ids = _routing.reform_for(mesh if mesh else 1)
+        new_mesh = _health.normalize_mesh(rung)
+        if new_mesh != mesh or device_ids is not None:
+            entry_reform = {"from": mesh, "to": new_mesh,
+                            "device_ids": (list(device_ids)
+                                           if device_ids else None),
+                            "reissued": 0}
+        mesh = new_mesh
+        # rung 0 = no healthy chip at all: host is the only rung left.
+        no_device_rung = rung < 1
     if health is None:
         health = _health.health_for(mesh)
     now = health.now
@@ -1912,6 +1966,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # see devcache.py.
         "devcache": dict(devcache_probe, dispatch_hits=0,
                          table_dispatch_hits=0),
+        # Degraded-mesh audit trail (round 9): every reformation this
+        # call performed — at entry (dead chips known before dispatch)
+        # or mid-wave (a chip died under an in-flight chunk, whose
+        # undecided batches were re-issued on the reformed rung).
+        "mesh_reformations": [entry_reform] if entry_reform else [],
+        "device_ids": list(device_ids) if device_ids else None,
         "seconds": 0.0,
     }
 
@@ -2138,6 +2198,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # for a cooldown period.
     if (_config.get("ED25519_TPU_DISABLE_DEVICE")  # explicit opt-outs
             #       only (config.py `opt-in` type), like DISABLE_NATIVE
+            or no_device_rung  # every chip dead: host is the last rung
             or not health.device_allowed()):
         # ED25519_TPU_DISABLE_DEVICE: config knob (SURVEY.md §5) forcing
         # the pure-host lane — also keeps jax entirely unloaded, which on
@@ -2147,7 +2208,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         while remaining:
             host_verify_one(remaining.pop())
         return _finish(verdicts)
-    dev = _DeviceLane.get(mesh=mesh, health=health)
+    dev = _DeviceLane.get(mesh=mesh, health=health,
+                          device_ids=device_ids)
 
     # Seconds-per-batch prior before the first measurement; the deadline
     # budget is 3×EMA×batches (2 s floor).  The default fits real TPU
@@ -2161,6 +2223,71 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     outstanding = []  # [(chunk_id, real idxs, t_submit, padded batches)]
     device_sick = False
     device_failed = False  # an error chunk: stop using the device this call
+    # Mid-wave reformation budget: each chip-loss event may step the
+    # ladder once; a storm that keeps killing chips walks 8→4→2→1 and
+    # then (budget spent or no rung left) lands on the host — the
+    # ladder's floor, never a livelock.
+    reforms_left = [4]
+
+    def try_reform(reissue_idxs) -> bool:
+        """Chip-loss escalation (round 9): a device failure on a mesh
+        with chips marked dead in the ChipRegistry is not a reason to
+        abandon the device path — reform onto the widest surviving
+        rung (mesh N → N/2 → … → single device; same-width placement
+        moves count too) and RE-ISSUE the failed chunks' undecided
+        batches there.  Returns False when the failure is not
+        chip-attributable (no dead chips — the classic host-fallback
+        ladder applies), no narrower rung exists, or the reformation
+        budget is spent; the caller then falls back to the host, the
+        ladder's floor.  Host confirmation of device verdicts is
+        untouched: re-issued batches re-stage with fresh blinders and
+        walk exactly the same decide path as any other chunk."""
+        nonlocal mesh, health, dev, device_ids, ema_is_prior, probed
+        if reforms_left[0] <= 0:
+            return False
+        chipreg = _health.chip_registry()
+        dead = chipreg.dead_chips()
+        if not dead:
+            return False
+        cur = (mesh if mesh else 1, device_ids)
+        rung, ids = _routing.reform_for(cur[0])
+        if (rung, ids) == cur:
+            # The registry still supports the current shape but the
+            # fault hit it anyway (e.g. the dead chip is outside this
+            # rung): step down one rung.
+            rung, ids = _routing.reform_for(max(1, cur[0] // 2))
+            if (rung, ids) == cur:
+                return False
+        if rung < 1:
+            return False  # no healthy chip: host is the only rung left
+        reforms_left[0] -= 1
+        old_mesh, new_mesh = mesh, _health.normalize_mesh(rung)
+        process_health = health is _health.health_for(old_mesh)
+        mesh, device_ids = new_mesh, ids
+        # Keep the caller's clock across the reformation: an injected
+        # fake-clock health must not silently degrade to wall time.
+        health = (_health.health_for(new_mesh) if process_health
+                  else _health.DeviceHealth(mesh=new_mesh,
+                                            clock=health.clock))
+        dev = _DeviceLane.get(mesh=new_mesh, health=health,
+                              device_ids=device_ids)
+        # The old width's EMA does not price the reformed rung; the
+        # first completed chunk re-measures (shape-completed grace
+        # covers a first compile of the reformed executable), and the
+        # reformed rung earns a fresh probe — without one, hybrid mode
+        # would quietly drain every re-issued batch host-side and the
+        # "reformed" mesh would never dispatch at all.
+        ema_is_prior = True
+        probed = False
+        stats["mesh"] = new_mesh
+        stats["device_ids"] = list(device_ids) if device_ids else None
+        stats["mesh_reformations"].append({
+            "from": old_mesh, "to": new_mesh,
+            "device_ids": list(device_ids) if device_ids else None,
+            "dead": sorted(dead), "reissued": len(reissue_idxs)})
+        _metrics.record_fault("mesh_reformed")
+        remaining.extend(reissue_idxs)
+        return True
 
     def submit(size=None):
         size = chunk if size is None else size
@@ -2245,23 +2372,49 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                     else (t0 + budget + 10.0)
                 if now() < deadline:
                     return progress
-                device_sick = True  # missed deadline
-                stats["device_sick"] = True
-                health.note_deadline_miss()
+                health.note_deadline_miss()  # bench the FAILED rung
                 _metrics.record_fault("deadline_miss")
                 dev.abandon()
-                for _, idxs2, _t, _b, _nl, _c in outstanding:
-                    for i in idxs2:
-                        host_verify_one(i)
+                undecided = [i for _, idxs2, _t, _b, _nl, _c
+                             in outstanding for i in idxs2
+                             if not decided[i]]
                 outstanding.clear()
+                if try_reform(undecided):
+                    # A chip died under the in-flight wave: the stall
+                    # was the mesh seizing, not the device lying — the
+                    # wave's chunks re-issue on the reformed rung
+                    # (verdict path unchanged; the host lane keeps
+                    # racing as ever).
+                    return True
+                device_sick = True  # missed deadline
+                stats["device_sick"] = True
+                for i in undecided:
+                    host_verify_one(i)
                 return True
             outstanding.pop(0)
             out, call_dt = res
             if out is None:  # device error: host decides, device benched
-                device_failed = True  # don't trust an error turnaround as
-                #                       a competitive EMA measurement
                 stats["device_errors"] += 1
                 _metrics.record_fault("device_error")
+                undecided = [i for i in idxs if not decided[i]]
+                inflight = [i for _c2, idxs2, _t2, _b2, _nl2, _v2
+                            in outstanding for i in idxs2
+                            if not decided[i]]
+                old_dev = dev
+                if try_reform(undecided + inflight):
+                    # Chip loss mid-wave (the error came from a mesh
+                    # with a chip marked dead): the failed chunk AND
+                    # every chunk still queued on the degraded lane
+                    # re-issue on the reformed rung.  The old lane is
+                    # healthy as a thread — just pointed at a dead
+                    # mesh — so its leftover results are discarded,
+                    # not waited for.
+                    for c2, _i2, _t2, _b2, _nl2, _v2 in outstanding:
+                        old_dev.discard(c2)
+                    outstanding.clear()
+                    return True
+                device_failed = True  # don't trust an error turnaround as
+                #                       a competitive EMA measurement
                 for i in idxs:
                     host_verify_one(i)
             else:

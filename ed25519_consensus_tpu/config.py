@@ -200,6 +200,14 @@ KNOBS: "dict[str, Knob]" = dict([
        "Default seed for tools/traffic_lab.py's open-loop arrival "
        "processes and workload construction (the run is a pure "
        "function of it)."),
+    _k("ED25519_TPU_DEGRADED_CAPACITY", "opt-out", True,
+       "Set to 0/false/no to stop VerifyService from shrinking its "
+       "admission-watermark base by the live healthy-chip fraction "
+       "when the mesh is degraded (chip loss); the hard queue bound "
+       "never shrinks either way."),
+    _k("ED25519_TPU_MESH_CHAOS_SEED", "int", 0xC41905,
+       "Default seed for tools/mesh_chaos.py's chip-loss storms and "
+       "workload construction (the run is a pure function of it)."),
 ])
 
 

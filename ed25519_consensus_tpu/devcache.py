@@ -154,7 +154,10 @@ class ResidentKeyset:
         self.tenant = tenant
         self.tenant_epoch = int(tenant_epoch)
         self.nbytes = int(head_tensor.nbytes)
-        self._device_refs = {}  # mesh key -> committed device array
+        # (mesh key, device_ids) -> committed device array; device_ids
+        # is the reformed-mesh placement, None for the canonical
+        # prefix (see device_ref / drop_refs_for_chip).
+        self._device_refs = {}
         self._seq = 0  # last-used lookup sequence (cache-maintained)
 
     @property
@@ -168,21 +171,54 @@ class ResidentKeyset:
         return hashlib.sha256(
             self.head_tensor.tobytes()).digest() == self.head_hash
 
-    def device_ref(self, mesh: int = 0):
+    def device_ref(self, mesh: int = 0, device_ids: "tuple | None" = None):
         """The committed device array for this entry under a dispatch
         mode, `jax.device_put` on first use and reused thereafter, so a
-        steady-state hit pays zero H2D for the head.  Callers hold the
-        device-call lock (the lane worker does); errors propagate to
-        the worker's supervision and become an ordinary device-error
-        fallback."""
-        key = _health.normalize_mesh(mesh)
+        steady-state hit pays zero H2D for the head.  `device_ids` is
+        the reformed-mesh placement (round 9): a rung on a surviving
+        chip subset keys — and stages — its own copy, so a reformation
+        never reuses an array whose placement included a dead chip.
+        Callers hold the device-call lock (the lane worker does);
+        errors propagate to the worker's supervision and become an
+        ordinary device-error fallback."""
+        key = (_health.normalize_mesh(mesh),
+               tuple(device_ids) if device_ids else None)
         ref = self._device_refs.get(key)
         if ref is None:
             import jax
 
-            ref = jax.device_put(self.head_tensor)
+            if key[1] is not None:
+                # Reformed placement: commit onto the FIRST surviving
+                # chip of the rung (the default device may be the dead
+                # chip — exactly why this placement exists; shard_map
+                # replicates/reshards from there as its in_specs
+                # require).
+                ref = jax.device_put(self.head_tensor,
+                                     jax.devices()[key[1][0]])
+            else:
+                ref = jax.device_put(self.head_tensor)
             self._device_refs[key] = ref
         return ref
+
+    def drop_refs_for_chip(self, chip: int) -> int:
+        """Drop the device arrays whose placement COVERS `chip` (the
+        per-shard accounting of a chip loss): a prefix mesh of width m
+        covers chips [0, m) — the single-device lane (key 0) covers
+        chip 0 — and an explicit reformed placement covers exactly its
+        ids.  The HOST mirror, the pinned hash, and every other
+        placement's array survive: the entry stays resident and the
+        next dispatch on an unaffected rung re-uses (or re-puts) it
+        without restaging.  Returns the number of refs dropped."""
+        chip = int(chip)
+        dropped = 0
+        for key in list(self._device_refs):
+            m, ids = key
+            covered = (chip in ids) if ids is not None else (
+                chip < m or (m == 0 and chip == 0))
+            if covered:
+                del self._device_refs[key]
+                dropped += 1
+        return dropped
 
 
 class DeviceOperandCache:
@@ -235,7 +271,7 @@ class DeviceOperandCache:
             "hits": 0, "misses": 0, "evictions": 0,
             "restage_hash_mismatch": 0, "stale_epoch": 0,
             "builds": 0, "drops": 0, "tenant_rotations": 0,
-            "quota_rejected": 0,
+            "quota_rejected": 0, "chip_drops": 0,
         }
         # per-tenant hit/miss/eviction/staleness tallies (tenant ->
         # counter dict), the fairness numbers the traffic lab and the
@@ -357,6 +393,26 @@ class DeviceOperandCache:
             _metrics.record_fault("devcache_drop_all")
         self._publish()
         return n
+
+    def drop_chip(self, chip: int, reason: str = "chip-loss") -> int:
+        """PER-SHARD residency accounting of a chip loss (round 9):
+        drop only the device arrays whose placement covered the dead
+        chip — every entry's host mirror, pinned hash, tenant
+        partition, and every surviving chip's arrays stay exactly as
+        they were, so tenants resident on surviving chips keep their
+        hit rate through the loss.  Contrast `drop_all`, which remains
+        the LANE-death rung (an abandoned worker's device memory is
+        untrusted wholesale).  Returns the number of device refs
+        dropped."""
+        with self._lock:
+            dropped = sum(e.drop_refs_for_chip(chip)
+                          for e in self._entries.values())
+            if dropped:
+                self.counters["chip_drops"] += dropped
+        if dropped:
+            _metrics.record_fault("devcache_chip_drop", dropped)
+        self._publish()
+        return dropped
 
     def resident_bytes(self) -> int:
         with self._lock:
@@ -739,3 +795,18 @@ def _on_residency_drop(reason: str) -> None:
 
 
 _health.register_residency_drop_listener(_on_residency_drop)
+
+
+# Chip loss drops ONLY the dead chip's device-side residency (round 9,
+# per-shard accounting): surviving chips' arrays, every host mirror,
+# and every tenant partition stay — the reformed mesh re-puts what it
+# needs under its own placement key.  Registered once at import, same
+# contract as the residency listener.
+def _on_chip_drop(chip: int, reason: str) -> None:
+    with _default_lock:
+        cache = _default[0]
+    if cache is not None:
+        cache.drop_chip(chip, reason)
+
+
+_health.register_chip_drop_listener(_on_chip_drop)

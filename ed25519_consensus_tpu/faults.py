@@ -57,8 +57,9 @@ __all__ = [
     "LaneDeathSignal",
     "Fault", "ErrorOn", "StallFor", "FlappingLink", "CorruptSum",
     "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
-    "RotateTenant",
+    "RotateTenant", "ChipLoss", "LinkFlap",
     "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
+    "mesh_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
 ]
@@ -221,6 +222,82 @@ class KillLane(Fault):
             clock.advance(self.advance)
         raise LaneDeathSignal(
             f"injected lane death (call={ctx.index})")
+
+
+class ChipLoss(Fault):
+    """Kill chip(s) AT the faulted dispatch: marks them dead in the
+    process chip registry (health.chip_registry) and errors the call —
+    the shape of an ICI neighbor vanishing mid-all-reduce, which takes
+    the whole collective down with it.  `chip` is one index or an
+    iterable (a power-domain or rack event kills neighbors together —
+    ONE mid-wave event, one error, several chips gone).  Defaults to
+    the SHARDED seam (the all-reduce is where a chip loss manifests
+    mid-wave); the scheduler's reformation ladder then reforms the
+    mesh onto the surviving subset and re-issues the wave's chunks.
+    `heal_after` models a transient loss (seconds on the registry
+    clock): the chips rejoin once the window elapses, and routing
+    reforms back to the full mesh.  Verdict-neutral like every device
+    fault: the failed call only ever removes a rung from the race."""
+
+    def __init__(self, chip, on=0, heal_after: "float | None" = None,
+                 site: str = SITE_SHARDED):
+        super().__init__(on=on, site=site)
+        self.chips = (tuple(int(c) for c in chip)
+                      if hasattr(chip, "__iter__") else (int(chip),))
+        self.heal_after = heal_after
+
+    def before(self, ctx):
+        from . import health as _health
+
+        reg = _health.chip_registry()
+        for c in self.chips:
+            reg.mark_chip_dead(
+                c, heal_after=self.heal_after,
+                reason=f"injected chip loss (site={ctx.site}, "
+                       f"call={ctx.index})")
+        raise InjectedFault(
+            f"injected chip loss: chips {list(self.chips)} died "
+            f"mid-wave (site={ctx.site}, call={ctx.index})")
+
+
+class LinkFlap(Fault):
+    """Chip `chip`'s ICI link flaps with period `period` over the
+    faulted site's call stream: calls in every other period-sized
+    window find the link DOWN — the chip is marked dead in the chip
+    registry and the call errors — while up-window calls find it
+    healed (the registry entry clears, so routing reforms back up the
+    ladder).  Unlike `FlappingLink` (which only errors calls), the
+    flap is visible to the reformation machinery: the scheduler steps
+    the mesh down during down windows and rejoins after the link
+    comes back.  Every down-window mark ALSO carries a `heal_after`
+    window on the registry clock: once the ladder has stepped below
+    the sharded rung, no further calls reach this seam to observe an
+    up window, so without the time bound one flap would degrade the
+    mesh forever — a flap is transient by definition."""
+
+    def __init__(self, chip: int, period: int = 2,
+                 site: str = SITE_SHARDED, heal_after: float = 30.0):
+        if period < 1:
+            raise ValueError("period must be >= 1")
+        super().__init__(on=lambda i: True, site=site)
+        self.chip = int(chip)
+        self.period = int(period)
+        self.heal_after = float(heal_after)
+
+    def before(self, ctx):
+        from . import health as _health
+
+        down = (ctx.index // self.period) % 2 == 1
+        reg = _health.chip_registry()
+        if down:
+            reg.mark_chip_dead(
+                self.chip, heal_after=self.heal_after,
+                reason=f"injected link flap (site={ctx.site}, "
+                       f"call={ctx.index})")
+            raise InjectedFault(
+                f"flapping ICI link down: chip {self.chip} "
+                f"(site={ctx.site}, call={ctx.index})")
+        reg.heal_chip(self.chip)
 
 
 class CorruptResidentEntry(Fault):
@@ -470,6 +547,41 @@ def devcache_plan(seed: int, kind: str, at: int = 0,
         faults = [RotateTenant(on=window, tenant=tenant)]
     else:
         raise ValueError(f"unknown devcache fault kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
+def mesh_plan(seed: int, kind: str, chips=(0,), at: int = 0,
+              stagger: int = 0, heal_after: "float | None" = None,
+              period: int = 2, site: str = SITE_SHARDED) -> FaultPlan:
+    """A chip-loss schedule over the SHARDED dispatch stream — the
+    degraded-mesh ladder's storm input (tools/mesh_chaos.py replays
+    these from a seed):
+
+    * ``"chip-loss"`` — every chip in `chips` dies at call index
+      `at + k·stagger` (k-th chip; stagger 0 = ONE mid-wave event
+      killing all of them together — a single ChipLoss over the whole
+      set, since the first raising fault aborts a call's fault loop).
+      `heal_after` > 0 makes each loss transient: the chips rejoin
+      after that many registry-clock seconds and the mesh reforms back
+      to full width.
+    * ``"link-flap"`` — `chips[0]`'s ICI link flaps with `period`
+      (`at`/`stagger` ignored — flapping has no window).
+
+    Same replay property as every other plan: decisions are pure
+    functions of (seed, site, call index)."""
+    chips = [int(c) for c in chips] or [0]
+    if kind == "chip-loss":
+        if stagger <= 0:
+            faults = [ChipLoss(chips, on=at, heal_after=heal_after,
+                               site=site)]
+        else:
+            faults = [ChipLoss(c, on=at + k * stagger,
+                               heal_after=heal_after, site=site)
+                      for k, c in enumerate(chips)]
+    elif kind == "link-flap":
+        faults = [LinkFlap(chips[0], period=period, site=site)]
+    else:
+        raise ValueError(f"unknown mesh fault kind {kind!r}")
     return FaultPlan(faults, seed=seed)
 
 

@@ -43,9 +43,11 @@ import time
 
 __all__ = [
     "Clock", "FakeClock", "SYSTEM_CLOCK", "DeviceHealth", "Backoff",
+    "ChipRegistry", "chip_registry",
     "normalize_mesh", "health_for", "reset_all", "any_lane_stuck",
     "set_any_lane_stuck", "register_residency_drop_listener",
-    "notify_residency_drop",
+    "notify_residency_drop", "register_chip_drop_listener",
+    "notify_chip_drop",
 ]
 
 
@@ -145,6 +147,147 @@ def notify_residency_drop(reason: str) -> None:
             fn(reason)
         except Exception:
             pass
+
+
+# Chip-drop listeners (round 9, degraded-mesh): losing ONE chip must
+# drop only that chip's device-side residency, not every partition —
+# devcache registers its per-shard drop here.  Same contract as the
+# residency listeners: run outside every health/registry lock, never
+# raise, append-only process wiring (CL004-reviewed).
+_chip_drop_listeners = []
+
+
+def register_chip_drop_listener(fn) -> None:
+    """Register `fn(chip: int, reason: str)` to run whenever a chip is
+    marked dead in the ChipRegistry.  Idempotent by identity."""
+    with _latch_lock:
+        if fn not in _chip_drop_listeners:
+            _chip_drop_listeners.append(fn)
+
+
+def notify_chip_drop(chip: int, reason: str) -> None:
+    """Run every chip-drop listener (outside all registry locks).
+    Listener failures never break the health transition — dropping a
+    chip's residency is optimization-state cleanup, never
+    verdict-relevant."""
+    with _latch_lock:
+        listeners = list(_chip_drop_listeners)
+    for fn in listeners:
+        try:
+            fn(chip, reason)
+        except Exception:
+            pass
+
+
+class ChipRegistry:
+    """Process-wide liveness of the PHYSICAL accelerator chips (device
+    indices as jax enumerates them) — the input the round-9 mesh
+    reformation ladder reads.
+
+    `DeviceHealth` answers "is the mesh-D dispatch mode trustworthy
+    right now"; this registry answers the finer question "WHICH chips
+    are alive" — what the scheduler needs to reform an 8-chip mesh onto
+    the surviving subset instead of abandoning the whole mesh path when
+    one chip (or its ICI link) dies mid-wave.
+
+    * `mark_chip_dead(chip, heal_after=None)` — chip loss.  A finite
+      `heal_after` (seconds on the registry clock) models a transient
+      loss (link flap, preemption): the chip REJOINS automatically once
+      the window elapses, so routing reforms back to the full mesh.
+      None is a permanent loss (operator `heal_chip` rejoins it).
+      Marking notifies the chip-drop listeners (devcache drops exactly
+      that chip's device-side residency, nobody else's).
+    * `dead_chips()` / `healthy_count(total)` / `surviving(want,
+      total)` — the read side routing and the scheduler consult; reads
+      prune healed windows, which is how rejoin happens with no
+      explicit transition.
+
+    Liveness here is REPORTED state (fault injection, an operator, an
+    external health checker) — the scheduler reacts to it but never
+    guesses it from a generic device error, so no existing failure
+    path changes behavior unless a chip was explicitly marked.  Same
+    thread contract as DeviceHealth: every field under the lock, no
+    call-outs (listeners run outside), all timestamps from `clock`."""
+
+    def __init__(self, clock: "Clock | None" = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        self._dead = {}  # chip index -> heal-at time (inf = permanent)
+
+    def set_clock(self, clock: "Clock | None") -> None:
+        """Inject the registry's time source (tests / the chaos lab
+        share one FakeClock with the scheduler's health objects so
+        heal windows advance on the same timeline)."""
+        with self._lock:
+            self.clock = clock if clock is not None else SYSTEM_CLOCK
+
+    def mark_chip_dead(self, chip: int, heal_after: "float | None" = None,
+                       reason: str = "chip-loss") -> None:
+        chip = int(chip)
+        with self._lock:
+            heal_at = (float("inf") if heal_after is None
+                       else self.clock.monotonic() + float(heal_after))
+            # Monotone per chip: a racing shorter window never shortens
+            # an armed longer one (same discipline as the cooldowns).
+            self._dead[chip] = max(self._dead.get(chip, 0.0), heal_at)
+        # Outside the lock (module contract): the dead chip's
+        # device-side residency drops — and only its.
+        notify_chip_drop(chip, reason)
+
+    def heal_chip(self, chip: int) -> None:
+        with self._lock:
+            self._dead.pop(int(chip), None)
+
+    def heal_all(self) -> None:
+        with self._lock:
+            self._dead.clear()
+
+    def dead_chips(self) -> "frozenset[int]":
+        """The currently-dead chip indices; reading prunes every healed
+        window (rejoin is a read-side transition — no daemon)."""
+        with self._lock:
+            now = self.clock.monotonic()
+            healed = [c for c, t in self._dead.items() if now >= t]
+            for c in healed:
+                del self._dead[c]
+            return frozenset(self._dead)
+
+    def healthy_count(self, total: int) -> int:
+        """How many of the chips [0, total) are alive right now."""
+        dead = self.dead_chips()
+        return sum(1 for c in range(int(total)) if c not in dead)
+
+    def surviving(self, want: int, total: int) -> "tuple[int, ...] | None":
+        """The first `want` healthy chip indices among [0, total), or
+        None when fewer than `want` survive.  The reformation ladder
+        places the reformed mesh on exactly these."""
+        dead = self.dead_chips()
+        out = [c for c in range(int(total)) if c not in dead]
+        return tuple(out[:int(want)]) if len(out) >= int(want) else None
+
+    def reset(self) -> None:
+        """Clear all chip-death state and restore the process clock
+        (test teardown via `reset_all`)."""
+        with self._lock:
+            self._dead.clear()
+            self.clock = SYSTEM_CLOCK
+
+    def __repr__(self):
+        with self._lock:
+            return f"ChipRegistry(dead={sorted(self._dead)})"
+
+
+# The process chip registry: chip liveness is inherently process-scoped
+# (the physical devices are shared by every dispatch mode), so one
+# instance, like the lane-stuck latch.  Tests inject a FakeClock via
+# set_clock and reset through reset_all.
+_chip_registry = ChipRegistry()
+
+
+def chip_registry() -> ChipRegistry:
+    """The process ChipRegistry (chip liveness for the reformation
+    ladder — routing.reform_for and the scheduler consult this)."""
+    return _chip_registry
 
 
 class DeviceHealth:
@@ -426,14 +569,16 @@ def health_for(mesh: int = 0) -> DeviceHealth:
 
 
 def reset_all() -> None:
-    """Reset every registered DeviceHealth and the process-wide
-    lane-stuck latch (batch.reset_device_health delegates here)."""
+    """Reset every registered DeviceHealth, the process-wide lane-stuck
+    latch, and the chip registry (batch.reset_device_health delegates
+    here)."""
     with _registry_lock:
         healths = list(_registry.values())
     for h in healths:
         h.reset()
     with _latch_lock:
         _lane_stuck_latch[0] = False
+    _chip_registry.reset()
 
 
 def any_lane_stuck() -> bool:

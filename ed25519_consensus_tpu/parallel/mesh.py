@@ -12,17 +12,34 @@ from jax.sharding import Mesh
 BATCH_AXIS = "batch"
 
 
-def batch_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+def batch_mesh(n_devices: int | None = None, devices=None,
+               device_ids=None) -> Mesh:
     """A 1-D mesh over the first `n_devices` available devices (all by
-    default)."""
+    default), or — degraded-mesh reformation (round 9) — over the
+    explicit surviving chip indices `device_ids` (which must then
+    match `n_devices` in count)."""
     if devices is None:
-        devices = jax.devices()
-        if n_devices is not None:
-            if n_devices > len(devices):
+        all_devices = jax.devices()
+        if device_ids is not None:
+            if n_devices is not None and n_devices != len(device_ids):
                 raise ValueError(
-                    f"requested {n_devices} devices, have {len(devices)}"
-                )
-            devices = devices[:n_devices]
+                    f"n_devices={n_devices} but {len(device_ids)} "
+                    f"device ids")
+            try:
+                devices = [all_devices[i] for i in device_ids]
+            except IndexError:
+                raise ValueError(
+                    f"device ids {device_ids!r} out of range for "
+                    f"{len(all_devices)} devices")
+        else:
+            devices = all_devices
+            if n_devices is not None:
+                if n_devices > len(devices):
+                    raise ValueError(
+                        f"requested {n_devices} devices, "
+                        f"have {len(devices)}"
+                    )
+                devices = devices[:n_devices]
     import numpy as np
 
     return Mesh(np.array(devices), (BATCH_AXIS,))

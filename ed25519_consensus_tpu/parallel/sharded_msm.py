@@ -93,12 +93,19 @@ def _compiled_sharded_kernel(n_devices: int, lanes_per_device: int,
 def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
                                   lanes_per_device: int, nwin: int,
                                   wire: str = "extended",
-                                  dwire: str = "plain"):
+                                  dwire: str = "plain",
+                                  device_ids: "tuple | None" = None):
     """Batched mesh kernel for the throughput scheduler: B stacked
     verification batches, each one's MSM terms sharded over the device
     mesh, partial Edwards sums all-gathered and folded per batch — one
     launch for the whole chunk, exactly like the single-device
     dispatch_window_sums_many but data-parallel over the mesh.
+
+    `device_ids` places the mesh on an explicit surviving chip subset
+    (degraded-mesh reformation, round 9) instead of the canonical
+    0..D−1 prefix; it is part of the compile key — a reformed mesh is
+    a different executable, but the SAME program over the same shard
+    layout, so the all-gathered Edwards fold is term-identical.
 
     Global shapes: digits (B, nwin, N), points (B, 2|4, NLIMBS, N) with
     N = n_devices · lanes_per_device → replicated (B, 4, NLIMBS, nwin)."""
@@ -114,7 +121,7 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
     from ..ops import jnp_edwards as E
     import jax.numpy as jnp
 
-    mesh = mesh_lib.batch_mesh(n_devices)
+    mesh = mesh_lib.batch_mesh(n_devices, device_ids=device_ids)
     axis = mesh_lib.BATCH_AXIS
     local_kernel = msm_lib._compiled_kernel.__wrapped__(
         lanes_per_device, nwin
@@ -155,7 +162,8 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
 def _compiled_sharded_kernel_many_cached(n_devices: int, n_batches: int,
                                          n_head: int, r_per_dev: int,
                                          nwin: int,
-                                         dwire: str = "packed"):
+                                         dwire: str = "packed",
+                                         device_ids: "tuple | None" = None):
     """The mesh lane's cache-aware dispatch (round 7, devcache.py):
     per-shard residency of the keyset head under the sharded MSM.
 
@@ -190,7 +198,7 @@ def _compiled_sharded_kernel_many_cached(n_devices: int, n_batches: int,
     from ..ops import jnp_edwards as E
     import jax.numpy as jnp
 
-    mesh = mesh_lib.batch_mesh(n_devices)
+    mesh = mesh_lib.batch_mesh(n_devices, device_ids=device_ids)
     axis = mesh_lib.BATCH_AXIS
     local_kernel = msm_lib._compiled_kernel.__wrapped__(
         n_head + r_per_dev, nwin
@@ -234,7 +242,8 @@ def _compiled_sharded_kernel_many_cached(n_devices: int, n_batches: int,
 
 
 def sharded_window_sums_many_cached(head_digits, r_digits, head, rwire,
-                                    n_devices: int, clock=None):
+                                    n_devices: int, clock=None,
+                                    device_ids=None):
     """Batched cache-aware mesh dispatch (see the compiled builder):
     returns the replicated (B, 4, NLIMBS, nwin) window sums.  Passes
     through the SITE_SHARDED fault seam like the cold mesh dispatch —
@@ -248,6 +257,7 @@ def sharded_window_sums_many_cached(head_digits, r_digits, head, rwire,
     kernel = _compiled_sharded_kernel_many_cached(
         n_devices, r_digits.shape[0], n_head,
         r_digits.shape[2] // n_devices, nwin, dwire=dwire,
+        device_ids=device_ids,
     )
     return _faults.run_device_call(
         _faults.SITE_SHARDED,
@@ -270,7 +280,8 @@ def shard_pad_cached(n_sigs: int, n_head: int, n_devices: int) -> int:
     return (pad - n_head) * n_devices
 
 
-def sharded_window_sums_many(digits, pts, n_devices: int, clock=None):
+def sharded_window_sums_many(digits, pts, n_devices: int, clock=None,
+                             device_ids=None):
     """Batched mesh dispatch (the scheduler's device-lane call when a
     mesh is configured): digits (B, nwin, N), points in any wire format
     → (B, 4, NLIMBS, nwin) device array.
@@ -280,7 +291,9 @@ def sharded_window_sums_many(digits, pts, n_devices: int, clock=None):
     can fault the mesh all-reduce independently of the single-device
     dispatch.  `clock` is the caller's health clock (the device lane
     passes its own), so clock-aware faults — StallFor's virtual
-    advance — behave identically at both seams."""
+    advance — behave identically at both seams.  `device_ids` places a
+    REFORMED mesh on the surviving chip subset (round 9); the default
+    None is the canonical 0..D−1 prefix mesh."""
     from .. import faults as _faults
 
     dwire = msm_lib.digit_wire_of(digits)
@@ -288,6 +301,7 @@ def sharded_window_sums_many(digits, pts, n_devices: int, clock=None):
     kernel = _compiled_sharded_kernel_many(
         n_devices, digits.shape[0], digits.shape[2] // n_devices,
         nwin, wire=msm_lib.wire_of(pts), dwire=dwire,
+        device_ids=device_ids,
     )
     return _faults.run_device_call(
         _faults.SITE_SHARDED, lambda: kernel(digits, pts),

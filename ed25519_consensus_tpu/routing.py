@@ -54,7 +54,8 @@ from . import health as _health
 
 __all__ = [
     "RoutingPolicy", "default_policy", "set_default_policy",
-    "available_devices", "estimate_device_terms",
+    "available_devices", "healthy_device_count", "reform_for",
+    "estimate_device_terms",
 ]
 
 # r5 scaling-lab constants (BASELINE.md mesh section): tunneled per-call
@@ -88,6 +89,56 @@ def available_devices() -> int:
         except Exception:
             _device_count[0] = 0
     return _device_count[0]
+
+
+def healthy_device_count(total: "int | None" = None) -> int:
+    """The LIVE healthy device count: the configured/available device
+    count minus the chips the process ChipRegistry currently marks
+    dead.  THE input N* must be computed from — a mesh that lost k of
+    its N chips has the capacity of an (N−k)-chip mesh, whatever the
+    configured size says (the round-9 routing fix)."""
+    d = available_devices() if total is None else int(total)
+    if d <= 0:
+        return 0
+    return _health.chip_registry().healthy_count(d)
+
+
+def reform_for(width: "int | None" = None
+               ) -> "tuple[int, tuple[int, ...] | None]":
+    """The escalation-ladder rung the live chip set supports for a
+    requested mesh width: ``(rung, device_ids)``.
+
+    `rung` is the largest power of two ≤ min(width, live healthy
+    count) — the 8→4→2→1 reformation ladder; 1 means the single-device
+    lane, 0 means no healthy chip remains (host is the only rung
+    left).  `device_ids` is the tuple of surviving chip indices the
+    rung runs on, or None when they are exactly 0..rung−1 (the
+    canonical prefix mesh — same executable, no re-compile).  With a
+    fully-healthy mesh this is the identity: ``reform_for(D) == (D,
+    None)`` for any power-of-two D ≤ the device count, so nothing
+    changes until a chip is actually marked dead."""
+    d = available_devices() if width is None else int(width)
+    if d <= 0:
+        return 0, None
+    # The substitution universe: ALL addressable chips, not just the
+    # requested width — losing chip 1 of a 2-mesh on an 8-chip box
+    # reforms onto (0, 2), it does not collapse to a single device.
+    # max() keeps explicit-width callers working on hosts where the
+    # device probe reports 0 (jax-less / DISABLE_DEVICE): an explicit
+    # width is the caller's assertion of the device world.
+    total = max(available_devices(), d)
+    live = min(healthy_device_count(total), d)
+    if live <= 0:
+        return 0, None
+    rung = 1
+    while rung * 2 <= live:
+        rung *= 2
+    ids = _health.chip_registry().surviving(rung, total)
+    if ids is None:
+        return 0, None
+    if ids == tuple(range(rung)):
+        ids = None
+    return rung, ids
 
 
 def estimate_device_terms(verifier) -> int:
@@ -184,7 +235,16 @@ class RoutingPolicy:
         `last_run_stats["devcache"]`); see `crossover_terms`."""
         if not self.auto_mesh:
             return 0
-        d = available_devices() if n_devices is None else int(n_devices)
+        d_cfg = available_devices() if n_devices is None \
+            else int(n_devices)
+        if d_cfg < self.min_devices:
+            return 0
+        # Round 9 (degraded-mesh): the candidate width is the LIVE
+        # reformation rung, not the configured mesh size — N* comes
+        # from the healthy-device count the dispatch would actually
+        # shard over, so a half-dead 8-mesh routes exactly like a
+        # healthy 4-mesh instead of modelling capacity it lost.
+        d, _ids = reform_for(d_cfg)
         if d < self.min_devices:
             return 0
         if est_terms_per_batch <= self.crossover_terms(

@@ -66,6 +66,7 @@ import threading
 from collections import deque
 
 from . import batch as _batch
+from . import config as _config
 from . import health as _health
 from . import routing as _routing
 from . import tenancy as _tenancy
@@ -326,14 +327,6 @@ class VerifyService:
             low_watermark=low_watermark,
             rpc_watermark=rpc_watermark)
         self.capacity_sigs = int(capacity_sigs)
-        self._class_high = {
-            cls: (None if p.shed_watermark is None
-                  else p.shed_watermark * self.capacity_sigs)
-            for cls, p in self.class_policies.items()}
-        self._class_low = {
-            cls: (None if p.resume_watermark is None
-                  else p.resume_watermark * self.capacity_sigs)
-            for cls, p in self.class_policies.items()}
         self.wave_max_batches = int(wave_max_batches)
         self.chunk = chunk
         self.hybrid = hybrid
@@ -371,6 +364,9 @@ class VerifyService:
             # stream should see hot_waves track device_waves once the
             # validator keyset recurs.
             "devcache_hot_waves": 0, "devcache_dispatch_hits": 0,
+            # Device waves dispatched on a reformed (degraded) mesh
+            # shape instead of the configured one (round 9).
+            "degraded_waves": 0,
         }
         # Per-class lifecycle tallies (the fairness surface the traffic
         # lab and the SLO gates read): every submission lands in
@@ -391,6 +387,53 @@ class VerifyService:
 
     def now(self) -> float:
         return self._clock.monotonic()
+
+    def effective_capacity_sigs(self) -> int:
+        """The admission-capacity ESTIMATE the per-class watermarks are
+        measured against — shrunk by the live healthy-chip fraction
+        when the mesh is degraded (round 9).  Losing k of N chips cuts
+        drain throughput ~k/N, so the same queue depth now represents
+        proportionally more drain time; keeping watermarks at the
+        full-mesh capacity would admit mempool/rpc load the degraded
+        mesh cannot clear inside the consensus deadline.  Scaling the
+        watermark base keeps them honest: lower classes shed EARLIER
+        under degradation, which is exactly what preserves consensus
+        headroom (consensus still never watermark-sheds, and the hard
+        physical queue bound — host memory, not chip throughput —
+        stays at the configured capacity).  ED25519_TPU_DEGRADED_
+        CAPACITY=0 opts out; a host-forced service (mesh=0) never
+        scales.
+
+        The fraction is rung/width over the service's CONFIGURED
+        dispatch width (the full device count under auto-routing): a
+        chip dying OUTSIDE a narrow manual mesh costs this service
+        nothing and must not shrink its watermarks, and the achievable
+        rung (power-of-two, routing.reform_for) — not the raw healthy
+        count — is what the dispatch actually shards over."""
+        if self.mesh is not None and _health.normalize_mesh(self.mesh) == 0:
+            return self.capacity_sigs
+        if not _health.chip_registry().dead_chips():
+            return self.capacity_sigs  # common case: one empty-set read
+        if not _config.get("ED25519_TPU_DEGRADED_CAPACITY"):
+            return self.capacity_sigs
+        width = (_health.normalize_mesh(self.mesh)
+                 if self.mesh is not None
+                 else _routing.available_devices())
+        if width < 2:
+            return self.capacity_sigs
+        rung, _ids = _routing.reform_for(width)
+        if rung >= width:
+            return self.capacity_sigs
+        return max(1, int(self.capacity_sigs * max(rung, 1) / width))
+
+    def _watermark_sigs(self, cls: str, resume: bool = False
+                        ) -> "float | None":
+        """The class's shed (or resume) watermark in SIGNATURES, over
+        the CURRENT effective capacity — recomputed per decision so
+        degradation (and heal/rejoin) moves the thresholds live."""
+        p = self.class_policies[cls]
+        frac = p.resume_watermark if resume else p.shed_watermark
+        return None if frac is None else frac * self.effective_capacity_sigs()
 
     def submit(self, entries, deadline: "float | None" = None,
                timeout: "float | None" = None,
@@ -450,7 +493,11 @@ class VerifyService:
             # only draining below its resume watermark (dispatcher
             # side) disarms it.  Consensus-class has no watermark —
             # only the hard capacity check below can reject it.
-            high = self._class_high[cls]
+            # Watermarks are measured against the EFFECTIVE capacity
+            # (shrunk under mesh degradation — round 9) so they stay
+            # honest about drain time; the hard bound below stays at
+            # the configured capacity (host memory, not chip count).
+            high = self._watermark_sigs(cls)
             if high is not None and self._queue_sigs >= high:
                 self._set_shedding(cls, True)
             if self._shedding_cls[cls]:
@@ -533,8 +580,10 @@ class VerifyService:
                     self._queue_sigs -= req.sigs
                     wave.append(req)
             # Per-class hysteresis disarm: a class resumes admitting
-            # once TOTAL depth drains below its resume watermark.
-            for cls, low in self._class_low.items():
+            # once TOTAL depth drains below its resume watermark
+            # (over the live effective capacity, like the shed side).
+            for cls in self.class_policies:
+                low = self._watermark_sigs(cls, resume=True)
                 if (self._shedding_cls[cls] and low is not None
                         and self._queue_sigs <= low):
                     self._set_shedding(cls, False)
@@ -609,6 +658,28 @@ class VerifyService:
         vs = [r.verifier for r in reqs]
         try:
             if device:
+                # Device waves dispatch the REFORMED mesh shape, not
+                # the configured one (round 9): a manual mesh=D whose
+                # chips partially died runs — and, critically, a
+                # half-open breaker PROBES — the surviving rung.  A
+                # probe forced onto the dead full-width shape would
+                # fail forever and re-open the breaker on a perfectly
+                # healthy degraded mesh, silently losing the device
+                # path until full heal.  verify_many applies the same
+                # clamp internally; resolving it here keeps the wave
+                # accounting (degraded_waves) on the service surface.
+                mesh_arg = self.mesh
+                if (mesh_arg is not None
+                        and _health.normalize_mesh(mesh_arg) > 1
+                        and _health.chip_registry().dead_chips()):
+                    cfg_mesh = _health.normalize_mesh(mesh_arg)
+                    rung, _ids = _routing.reform_for(cfg_mesh)
+                    mesh_arg = rung if rung > 1 else 0
+                    if mesh_arg != cfg_mesh:
+                        # counted only when the resolved shape actually
+                        # changed — a dead chip OUTSIDE this rung is
+                        # not a degraded dispatch
+                        self.totals["degraded_waves"] += 1
                 # Probe waves force device participation (hybrid=False):
                 # a half-open breaker needs evidence, and a host-raced
                 # probe that never measures the device would stay
@@ -616,7 +687,7 @@ class VerifyService:
                 verdicts = _batch.verify_many(
                     vs, rng=self._rng, chunk=self.chunk,
                     hybrid=False if probe else self.hybrid,
-                    merge=self.merge, mesh=self.mesh,
+                    merge=self.merge, mesh=mesh_arg,
                     health=self.health, policy=self.policy)
                 stats = dict(_batch.last_run_stats)
                 self._note_device_outcome(stats, probe)
@@ -696,6 +767,7 @@ class VerifyService:
         with self._cv:
             return {
                 "queue_sigs": self._queue_sigs,
+                "effective_capacity_sigs": self.effective_capacity_sigs(),
                 "queue_requests": self._queued_requests(),
                 "queue_requests_by_class": {
                     cls: len(q) for cls, q in self._queues.items()},

@@ -223,6 +223,36 @@ def test_cl006_negative_tenancy_overbroad_except():
     assert rules_of(lint_fixture("tenancy.py", src)) == ["CL006"]
 
 
+def test_cl002_negative_mesh_chaos_raw_clock():
+    """The mesh-chaos lab is clock-critical (heal windows decide the
+    rejoin gate): every timestamp comes from the injected FakeClock,
+    never the wall."""
+    src = ("import time\n"
+           "def storm_tick():\n"
+           "    return time.monotonic()\n")
+    findings = lint_tool_fixture("tools/mesh_chaos.py", src)
+    assert rules_of(findings) == ["CL002"]
+
+
+def test_cl004_negative_mesh_chaos_module_global():
+    """Storm results accumulate in run-local state, never at module
+    level — a module-global ledger is ambient state across seeded
+    runs, exactly what makes a replay lie."""
+    findings = lint_tool_fixture("tools/mesh_chaos.py",
+                                 "_storm_results = []\n")
+    assert rules_of(findings) == ["CL004"]
+
+
+def test_cl006_negative_mesh_chaos_overbroad_except():
+    src = ("def gate(summary):\n"
+           "    try:\n"
+           "        return summary['ok']\n"
+           "    except Exception:\n"
+           "        return False\n")
+    assert rules_of(lint_tool_fixture("tools/mesh_chaos.py",
+                                      src)) == ["CL006"]
+
+
 def test_real_tenancy_and_traffic_lab_lint_clean():
     """The shipped modules themselves hold the contract they are now
     scoped under."""
@@ -231,6 +261,7 @@ def test_real_tenancy_and_traffic_lab_lint_clean():
     paths = [
         os.path.join(linter.PACKAGE_ROOT, "tenancy.py"),
         os.path.join(linter.REPO_ROOT, "tools", "traffic_lab.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "mesh_chaos.py"),
     ]
     findings = linter.lint_paths(paths)
     assert findings == [], [str(f) for f in findings]
@@ -614,14 +645,13 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 23 knobs (20 through the
-    round-7/8 tenancy work + the three round-8 kernel knobs: the
-    resident-tables opt-out, the tables-hot per-term routing scale,
-    and the shared-pad lane floor)."""
+    these rows) and the registry knows all 25 knobs (23 through the
+    round-8 kernel work + the two round-9 degraded-mesh knobs: the
+    effective-capacity opt-out and the mesh-chaos seed)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 23
+    assert len(rows) == len(config.KNOBS) == 25
     assert all(doc for (_, _, _, doc) in rows)
     for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
                  "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
@@ -629,7 +659,9 @@ def test_config_registry_covers_readme_table():
                  "ED25519_TPU_TRAFFIC_LAB_SEED",
                  "ED25519_TPU_DEVCACHE_TABLES",
                  "ED25519_TPU_DEVCACHE_TABLES_HOT_SCALE",
-                 "ED25519_TPU_MIN_LANES"):
+                 "ED25519_TPU_MIN_LANES",
+                 "ED25519_TPU_DEGRADED_CAPACITY",
+                 "ED25519_TPU_MESH_CHAOS_SEED"):
         assert name in config.KNOBS
 
 

@@ -1,0 +1,470 @@
+"""Degraded-mesh verification (round 9): chip registry, reformation
+ladder, mid-wave re-issue, per-shard residency drops, and the
+capacity-aware service surface.
+
+The property under test is the ISSUE-9 claim: losing k of N chips
+costs ~k/N throughput, never correctness and never a lost request —
+
+* `health.ChipRegistry` reports live chip liveness (heal windows
+  rejoin on the registry clock, no daemon);
+* `routing.reform_for` maps it to the 8→4→2→1 escalation-ladder rung
+  plus the surviving-chip placement, and `RoutingPolicy` computes N*
+  from the LIVE healthy count (a half-dead mesh routes like a
+  half-size mesh — the round-9 routing fix);
+* the scheduler reforms mid-wave on a chip-loss fault and RE-ISSUES
+  the in-flight wave's chunks on the reformed rung, verdicts
+  bit-identical to the host oracle;
+* devcache drops only the dead chip's device-side residency
+  (per-shard accounting — entries and surviving placements stay);
+* `VerifyService` shrinks its admission-watermark base by the healthy
+  fraction and probes the breaker on the REFORMED mesh shape.
+
+tools/mesh_chaos.py drives the full seeded storm (kill 1/3/7 of 8 +
+heal-and-rejoin) through real dispatches and the traffic lab in CI.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import (
+    SigningKey,
+    batch,
+    devcache,
+    faults,
+    health,
+    routing,
+    service,
+)
+from ed25519_consensus_tpu.ops import msm
+
+jax = pytest.importorskip("jax")
+
+rng = random.Random(0xDE64)
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    """Chip liveness is process-global: every test starts and ends
+    with a fully-healed registry (reset_device_health covers it).
+    Lane workers stay alive across tests (the PR 5 reuse idiom)."""
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    yield
+    faults.uninstall() if faults.active_plan() else None
+    devcache.set_default_cache(None)
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+    routing.set_default_policy(None)
+
+
+_KEYS = [SigningKey.new(rng) for _ in range(4)]
+
+
+def make_verifiers(n_batches, tag=b"md", bad=()):
+    out = []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        for j, sk in enumerate(_KEYS):
+            msg = b"%s-%d-%d" % (tag, b, j)
+            sig = sk.sign(msg if not (b in bad and j == 0)
+                          else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        out.append(v)
+    return out
+
+
+def host_verdicts(vs):
+    return [batch._host_verdict(v, rng) for v in vs]
+
+
+# -- ChipRegistry ----------------------------------------------------------
+
+def test_chip_registry_mark_heal_and_window():
+    clock = health.FakeClock()
+    reg = health.ChipRegistry(clock=clock)
+    assert reg.dead_chips() == frozenset()
+    reg.mark_chip_dead(3)                      # permanent
+    reg.mark_chip_dead(5, heal_after=10.0)     # transient
+    assert reg.dead_chips() == {3, 5}
+    assert reg.healthy_count(8) == 6
+    assert reg.surviving(4, 8) == (0, 1, 2, 4)
+    assert reg.surviving(7, 8) is None
+    clock.advance(10.5)                        # heal window elapses
+    assert reg.dead_chips() == {3}             # 5 rejoined on read
+    reg.heal_chip(3)
+    assert reg.dead_chips() == frozenset()
+
+
+def test_chip_registry_window_is_monotone():
+    """A racing shorter heal window never shortens an armed longer
+    one (same discipline as the health cooldowns)."""
+    clock = health.FakeClock()
+    reg = health.ChipRegistry(clock=clock)
+    reg.mark_chip_dead(1, heal_after=100.0)
+    reg.mark_chip_dead(1, heal_after=1.0)
+    clock.advance(50.0)
+    assert reg.dead_chips() == {1}
+
+
+def test_process_registry_resets_with_device_health():
+    reg = health.chip_registry()
+    fake = health.FakeClock()
+    reg.set_clock(fake)
+    reg.mark_chip_dead(2)
+    assert health.chip_registry().dead_chips() == {2}
+    batch.reset_device_health()
+    assert health.chip_registry().dead_chips() == frozenset()
+    assert health.chip_registry().clock is health.SYSTEM_CLOCK
+
+
+def test_chip_drop_listener_fires_on_mark():
+    seen = []
+    health.register_chip_drop_listener(
+        lambda chip, reason, _s=seen: _s.append((chip, reason)))
+    health.chip_registry().mark_chip_dead(6, reason="unit")
+    assert (6, "unit") in seen
+
+
+# -- the reformation ladder (routing.reform_for) ---------------------------
+
+def test_reform_for_identity_on_healthy_mesh():
+    for d in (1, 2, 4, 8):
+        assert routing.reform_for(d) == (d, None)
+
+
+def test_reform_for_walks_the_ladder():
+    reg = health.chip_registry()
+    reg.mark_chip_dead(7)
+    assert routing.reform_for(8) == (4, None)      # 7 healthy -> rung 4
+    for c in (6, 5):
+        reg.mark_chip_dead(c)
+    assert routing.reform_for(8) == (4, None)      # 5 healthy -> rung 4
+    for c in (4, 3):
+        reg.mark_chip_dead(c)
+    assert routing.reform_for(8) == (2, None)      # 3 healthy -> rung 2
+    for c in (2, 1):
+        reg.mark_chip_dead(c)
+    assert routing.reform_for(8) == (1, None)      # single device
+    reg.mark_chip_dead(0)
+    assert routing.reform_for(8) == (0, None)      # host only
+
+
+def test_reform_for_places_on_survivors():
+    """Non-prefix survivors: the rung carries the explicit surviving
+    device ids (a different executable, same program)."""
+    reg = health.chip_registry()
+    reg.mark_chip_dead(1)
+    assert routing.reform_for(2) == (2, (0, 2))
+    reg.mark_chip_dead(0)
+    assert routing.reform_for(2) == (2, (2, 3))
+    assert routing.reform_for(1) == (1, (2,))
+
+
+def test_reform_never_widens_beyond_request():
+    health.chip_registry().mark_chip_dead(0)
+    # width-1 request on a healthy-elsewhere mesh stays width 1
+    rung, ids = routing.reform_for(1)
+    assert rung == 1 and ids == (1,)
+
+
+# -- RoutingPolicy: live healthy count (the satellite fix) -----------------
+
+def test_half_dead_mesh_routes_like_half_size_mesh():
+    """REGRESSION (round 9): N* must come from the LIVE healthy count,
+    not the configured mesh size.  With 4 of 8 chips dead, the policy
+    must price — and return — a 4-chip mesh: an estimate between
+    N*(8) and N*(4) that a healthy 8-mesh would shard stays on the
+    single device, and a large estimate shards at width 4."""
+    pol = routing.RoutingPolicy(fixed_cost_s=0.030, per_term_s=1.3e-6)
+    h = health.DeviceHealth(mesh=8, clock=health.FakeClock())
+    n_star_8 = pol.crossover_terms(8)
+    n_star_4 = pol.crossover_terms(4)
+    between = int((n_star_8 + n_star_4) / 2)
+    assert pol.choose_mesh(between, n_devices=8, health=h) == 8
+    for c in (4, 5, 6, 7):
+        health.chip_registry().mark_chip_dead(c)
+    assert pol.choose_mesh(between, n_devices=8, health=h) == 0
+    assert pol.choose_mesh(int(n_star_4) + 1000, n_devices=8,
+                           health=h) == 4
+    health.chip_registry().heal_all()
+    assert pol.choose_mesh(between, n_devices=8, health=h) == 8
+
+
+# -- faults: ChipLoss / LinkFlap / mesh_plan -------------------------------
+
+def test_chip_loss_marks_and_errors():
+    plan = faults.FaultPlan(
+        [faults.ChipLoss((5, 6), on=1, heal_after=30.0)], seed=7)
+    assert plan.run(faults.SITE_SHARDED, lambda: "ok") == "ok"
+    with pytest.raises(faults.InjectedFault, match="chips \\[5, 6\\]"):
+        plan.run(faults.SITE_SHARDED, lambda: "ok")
+    assert health.chip_registry().dead_chips() == {5, 6}
+    assert plan.injection_log() == [
+        (faults.SITE_SHARDED, 1, "ChipLoss")]
+
+
+def test_link_flap_marks_then_heals():
+    plan = faults.FaultPlan([faults.LinkFlap(chip=3, period=1)], seed=7)
+    reg = health.chip_registry()
+    assert plan.run(faults.SITE_SHARDED, lambda: "up") == "up"  # idx 0
+    assert reg.dead_chips() == frozenset()
+    with pytest.raises(faults.InjectedFault, match="chip 3"):
+        plan.run(faults.SITE_SHARDED, lambda: "up")             # idx 1
+    assert reg.dead_chips() == {3}
+    assert plan.run(faults.SITE_SHARDED, lambda: "up") == "up"  # idx 2
+    assert reg.dead_chips() == frozenset()  # the link came back
+
+
+def test_mesh_plan_schedules_deterministically():
+    plan = faults.mesh_plan(0xAB, "chip-loss", chips=(5, 6), at=2,
+                            stagger=1)
+    sched = plan.schedule(faults.SITE_SHARDED, 5)
+    assert sched == [[], [], ["ChipLoss"], ["ChipLoss"], []]
+    flap = faults.mesh_plan(0xAB, "link-flap", chips=(4,), period=2)
+    assert all(k == ["LinkFlap"]
+               for k in flap.schedule(faults.SITE_SHARDED, 4))
+    with pytest.raises(ValueError, match="unknown mesh fault kind"):
+        faults.mesh_plan(0, "meteor")
+
+
+# -- devcache: per-shard residency accounting ------------------------------
+
+def _resident_entry(cache, name=b"k"):
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    d = devcache.keyset_digest(name * 32)
+    cache.should_build(d)
+    cache.build(d, 1, head)
+    return d, cache.lookup(d)
+
+
+def test_drop_chip_drops_only_covering_placements():
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 26,
+                                        enabled=True)
+    _d, e = _resident_entry(cache)
+    e.device_ref(0)             # single lane: covers chip 0
+    e.device_ref(8)             # prefix mesh-8: covers chips 0..7
+    e.device_ref(4, (1, 2, 3, 4))  # reformed placement
+    assert cache.drop_chip(5) == 1   # only the mesh-8 ref covers 5
+    assert set(e._device_refs) == {(0, None), (4, (1, 2, 3, 4))}
+    assert cache.drop_chip(0) == 1   # the single-lane ref covers 0
+    assert set(e._device_refs) == {(4, (1, 2, 3, 4))}
+    assert cache.drop_chip(3) == 1   # the reformed placement covers 3
+    assert cache.counters["chip_drops"] == 3
+    # the ENTRY survived every drop: hits keep flowing (per-shard
+    # accounting never touches the host mirror or the hash pin)
+    assert cache.lookup(_d) is not None
+
+
+def test_registry_mark_drops_default_cache_per_shard():
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 26,
+                                        enabled=True)
+    devcache.set_default_cache(cache)
+    _d, e = _resident_entry(cache)
+    e.device_ref(0)
+    e.device_ref(8)
+    health.chip_registry().mark_chip_dead(6)
+    assert set(e._device_refs) == {(0, None)}
+    assert cache.lookup(_d) is not None  # resident through the loss
+
+
+# -- scheduler: mid-wave reformation + re-issue ----------------------------
+
+def _mark_shapes(n_terms, meshes=(2,)):
+    from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+    for m in meshes:
+        msm.mark_shape_completed(2, shard_pad(n_terms, m), m)
+    msm.mark_shape_completed(2, msm.preferred_pad(n_terms), 0)
+
+
+def test_chip_loss_midwave_reforms_to_single_and_reissues():
+    """THE acceptance case at test scale (the full 8-chip storm runs
+    in tools/mesh_chaos.py): a mid-wave loss of every chip but 0 on a
+    2-mesh dispatch reforms to the single-device rung, RE-ISSUES the
+    wave's chunks there, and the re-issued dispatch — not the host
+    lane — decides them, bit-identical to the host oracle."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=2, clock=clock)
+    health.chip_registry().set_clock(clock)
+    vs = make_verifiers(2, tag=b"reform", bad={1})
+    want = host_verdicts(make_verifiers(2, tag=b"reform", bad={1}))
+    _mark_shapes(vs[0].clone()._stage(rng).n_device_terms)
+    plan = faults.FaultPlan(
+        [faults.ChipLoss(range(1, 8), on=0, heal_after=600.0)], seed=3)
+    with faults.injected(plan):
+        got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                merge="never", mesh=2, health=hp)
+    stats = dict(batch.last_run_stats)
+    assert got == want == [True, False]
+    refs = stats["mesh_reformations"]
+    assert refs and refs[-1]["from"] == 2 and refs[-1]["to"] == 0
+    assert refs[-1]["reissued"] == 2
+    assert stats["mesh"] == 0
+    participated = (stats["device_batches"]
+                    + stats["device_rejects_confirmed"]
+                    + stats["device_rejects_overturned"])
+    assert participated >= 1, "re-issued work never reached the device"
+    assert not stats["device_sick"]
+    # heal window: routing reforms back to the full width
+    clock.advance(601.0)
+    assert routing.reform_for(2) == (2, None)
+
+
+def test_dead_chip_zero_single_lane_runs_on_survivor():
+    """Chip 0 dead BEFORE the call: the single-device rung reforms
+    onto the first surviving chip (placement, not abandonment) — the
+    dispatch completes there and verdicts match the host."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=0, clock=clock)
+    health.chip_registry().set_clock(clock)
+    health.chip_registry().mark_chip_dead(0)
+    vs = make_verifiers(2, tag=b"chip0", bad={0})
+    want = host_verdicts(make_verifiers(2, tag=b"chip0", bad={0}))
+    _mark_shapes(vs[0].clone()._stage(rng).n_device_terms, meshes=())
+    got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                            merge="never", mesh=0, health=hp)
+    stats = dict(batch.last_run_stats)
+    assert got == want == [False, True]
+    assert stats["mesh"] == 0
+    assert stats["device_ids"] == [1]
+    participated = (stats["device_batches"]
+                    + stats["device_rejects_confirmed"]
+                    + stats["device_rejects_overturned"])
+    assert participated >= 1
+
+
+def test_all_chips_dead_falls_to_host():
+    """The ladder's floor: every chip dead → the pure-host loop, no
+    lane, no device error — verdicts unchanged, nothing lost."""
+    for c in range(8):
+        health.chip_registry().mark_chip_dead(c)
+    vs = make_verifiers(3, tag=b"floor", bad={2})
+    want = host_verdicts(make_verifiers(3, tag=b"floor", bad={2}))
+    got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                            merge="never", mesh=8)
+    stats = dict(batch.last_run_stats)
+    assert got == want == [True, True, False]
+    assert stats["host_batches"] == 3 and stats["device_batches"] == 0
+    assert stats["mesh"] == 0
+
+
+# -- VerifyService: capacity-aware degradation -----------------------------
+
+def _eight_devices(monkeypatch):
+    monkeypatch.setattr(routing, "_device_count", [8])
+
+
+def test_service_effective_capacity_shrinks_with_healthy_fraction(
+        monkeypatch):
+    _eight_devices(monkeypatch)
+    svc = service.VerifyService(capacity_sigs=100, auto_start=False,
+                                clock=health.FakeClock())
+    try:
+        assert svc.effective_capacity_sigs() == 100
+        for c in (4, 5, 6, 7):
+            health.chip_registry().mark_chip_dead(c)
+        assert svc.effective_capacity_sigs() == 50
+        # the rpc watermark shrinks with it; the hard bound does not
+        assert svc._watermark_sigs("rpc") == pytest.approx(0.5 * 50)
+        assert svc._watermark_sigs("consensus") is None
+        assert svc.capacity_sigs == 100
+        assert svc.stats()["effective_capacity_sigs"] == 50
+        health.chip_registry().heal_all()
+        assert svc.effective_capacity_sigs() == 100
+    finally:
+        svc.close()
+
+
+def test_service_degraded_capacity_knob_and_host_force(monkeypatch):
+    _eight_devices(monkeypatch)
+    for c in (4, 5, 6, 7):
+        health.chip_registry().mark_chip_dead(c)
+    monkeypatch.setenv("ED25519_TPU_DEGRADED_CAPACITY", "0")
+    svc = service.VerifyService(capacity_sigs=100, auto_start=False,
+                                clock=health.FakeClock())
+    try:
+        assert svc.effective_capacity_sigs() == 100  # opt-out
+    finally:
+        svc.close()
+    monkeypatch.delenv("ED25519_TPU_DEGRADED_CAPACITY")
+    svc2 = service.VerifyService(capacity_sigs=100, mesh=0,
+                                 auto_start=False,
+                                 clock=health.FakeClock())
+    try:
+        # a host-forced service has no chip-bound throughput to model
+        assert svc2.effective_capacity_sigs() == 100
+    finally:
+        svc2.close()
+
+
+def test_consensus_never_sheds_under_degradation(monkeypatch):
+    """The shrunk watermarks shed LOWER classes earlier; consensus
+    admission still only bounds at the full physical capacity."""
+    _eight_devices(monkeypatch)
+    for c in (2, 3, 4, 5, 6, 7):
+        health.chip_registry().mark_chip_dead(c)  # 2/8 alive
+    clock = health.FakeClock()
+    svc = service.VerifyService(capacity_sigs=100, auto_start=False,
+                                clock=clock)
+    try:
+        assert svc.effective_capacity_sigs() == 25
+        # rpc sheds once depth crosses 0.5 * 25 = 12.5 queued sigs
+        # under degradation (admission checks depth BEFORE enqueue, so
+        # the 4th 4-sig batch is the first to see depth >= 12.5)
+        for i in range(4):
+            svc.submit(make_verifiers(1, tag=b"c%d" % i)[0], cls="rpc")
+        with pytest.raises(service.Overloaded):
+            svc.submit(make_verifiers(1, tag=b"c4")[0], cls="rpc")
+        # consensus keeps admitting right up to the PHYSICAL bound
+        for i in range(21):  # 16 queued + 21*4 = 100 <= 100
+            svc.submit(make_verifiers(1, tag=b"k%d" % i)[0],
+                       cls="consensus")
+        with pytest.raises(service.Overloaded, match="queue full"):
+            svc.submit(make_verifiers(1, tag=b"kf")[0],
+                       cls="consensus")
+    finally:
+        svc.close(drain=False)
+
+
+def test_breaker_probe_runs_reformed_mesh_shape(monkeypatch):
+    """SATELLITE fix: after reformation the half-open probe must
+    dispatch the REFORMED shape — a probe forced onto the dead
+    full-width mesh would fail forever and latch the device path off
+    on a perfectly healthy degraded mesh."""
+    _eight_devices(monkeypatch)
+    seen = []
+
+    def fake_verify_many(vs, **kw):
+        seen.append(kw)
+        batch.last_run_stats.clear()
+        batch.last_run_stats.update({"device_batches": len(vs),
+                                     "devcache": {}})
+        return [True] * len(vs)
+
+    monkeypatch.setattr(batch, "verify_many", fake_verify_many)
+    clock = health.FakeClock()
+    svc = service.VerifyService(capacity_sigs=100, mesh=8,
+                                auto_start=False, clock=clock)
+    try:
+        health.chip_registry().mark_chip_dead(7)
+        # drive the breaker OPEN, then let the backoff expire
+        svc.breaker.record_failure("stall")
+        svc.breaker.record_failure("stall")
+        assert svc.breaker.state == service.BREAKER_OPEN
+        clock.advance(10.0)
+        svc.submit(make_verifiers(1, tag=b"probe")[0], cls="consensus")
+        svc.process_once()
+        assert seen, "the probe wave never dispatched"
+        assert seen[-1]["mesh"] == 4      # reformed, not configured 8
+        assert seen[-1]["hybrid"] is False  # forced-device probe
+        assert svc.breaker.state == service.BREAKER_CLOSED
+        assert svc.totals["probe_waves"] == 1
+        assert svc.totals["degraded_waves"] == 1
+        # healed: the next wave runs the configured full width again
+        health.chip_registry().heal_all()
+        svc.submit(make_verifiers(1, tag=b"full")[0], cls="consensus")
+        svc.process_once()
+        assert seen[-1]["mesh"] == 8
+    finally:
+        svc.close(drain=False)

@@ -469,18 +469,44 @@ def test_keyset_rotation_changes_content_address():
     assert digest_of(_KEYS_A) != digest_of(_KEYS_A2)
 
 
-def test_lane_death_still_drops_every_tenant(reset_state):
-    """Lane death is a DEVICE event, not a tenant event: all residency
-    drops (the replacement lane owes nothing to the old one),
-    whatever partition entries lived in."""
+def test_chip_loss_drops_only_dead_shard_residency(reset_state):
+    """Round 9 (replaces the round-8 'lane death drops all partitions'
+    pin with the per-shard form): CHIP loss is finer than lane death —
+    only the dead chip's device-side arrays drop; every tenant's
+    entries stay resident and tenant partitions on surviving chips
+    keep hit rate 1.0 straight through the loss.  Lane DEATH (an
+    abandoned worker — untrusted device memory wholesale) still drops
+    everything, pinned at the end."""
     cache = reset_state
     head = np.zeros((4, 20, 4), dtype=np.int16)
+    entries = {}
     for name, tag in ((b"a", "A"), (b"b", "B")):
         d = devcache.keyset_digest(name * 32)
         cache.assign_tenant(d, tag)
         cache.should_build(d)
         cache.build(d, 1, head)
+        entries[tag] = (d, cache.lookup(d))
     assert cache.resident_count() == 2
+    for _d, e in entries.values():
+        e.device_ref(0)   # single-lane placement (chip 0)
+        e.device_ref(8)   # full-mesh placement (chips 0..7)
+    # chip 5 dies: only the mesh-8 arrays (which cover chip 5) drop —
+    # per-shard accounting, not a partition wipe
+    health.chip_registry().mark_chip_dead(5)
+    assert cache.resident_count() == 2
+    hits = 0
+    for tag in ("A", "B"):
+        d, e = entries[tag]
+        assert set(e._device_refs) == {(0, None)}
+        assert cache.lookup(d) is not None
+        hits += 1
+    ts = cache.tenant_stats()
+    for tag in ("A", "B"):
+        assert ts[tag]["hit_rate"] == 1.0
+        assert ts[tag]["resident_keysets"] == 1
+        assert ts[tag]["evictions"] == 0
+    assert cache.counters["chip_drops"] == 2
+    # lane death remains the wholesale rung: ALL partitions drop
     h = health.DeviceHealth(clock=health.FakeClock())
     h.mark_lane_stuck()
     assert cache.resident_count() == 0

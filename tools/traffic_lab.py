@@ -228,7 +228,10 @@ def run_lab(cfg) -> dict:
         chunk=1 if cfg.device else 8,
         hybrid=False if cfg.device else True,
         merge="never" if cfg.device else "auto",
-        mesh=0,
+        # mesh_chaos runs the lab with mesh=None (auto) so the
+        # degraded-capacity watermark shrink engages; the default 0
+        # keeps the classic host-modelled lab byte-identical.
+        mesh=getattr(cfg, "mesh", 0),
         health=None if cfg.device else service._HostOnlyHealth(clock),
         clock=clock, rng=random.Random(_stable_seed(cfg.seed, "rng")),
         auto_start=False)
@@ -384,6 +387,7 @@ def summarize(cfg, matrix, requests, svc, cache, rate, capacity_sigs,
         "t_cap_s": t_cap,
         "horizon_s": horizon,
         "device": bool(cfg.device),
+        "effective_capacity_sigs": st["effective_capacity_sigs"],
         "rotation_faults": bool(cfg.rotation_faults and cfg.device),
         "by_class": by_class,
         "by_tenant_devcache": cache.tenant_stats() if cfg.device else {},
