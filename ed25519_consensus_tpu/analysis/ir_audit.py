@@ -302,6 +302,15 @@ def trace_variants(include_sharded: "bool | None" = None) -> dict:
                 2, _B, _N // 2, NWINDOWS, wire="compressed",
                 dwire="packed"),
             (digits, pts))
+        # The sentinel-AUDIT form (round 10): identical sharded MSM,
+        # result additionally exposes the per-chip partial window sums
+        # (observability only) — held to the same integer-only dtypes
+        # and the same exactly-['all_gather'] collective schedule.
+        variants["sharded-mesh2-audit"] = (
+            sharded_msm._compiled_sharded_kernel_many_audit(
+                2, _B, _N // 2, NWINDOWS, wire="compressed",
+                dwire="packed"),
+            (digits, pts))
         # The cache-aware mesh dispatch: per-shard lanes are
         # n_head + NR/D = 16 + 112 = 128 (a valid kernel lane count),
         # head digits on shard 0's slice only, head tensor replicated.
@@ -335,7 +344,8 @@ def build_manifest(include_sharded: "bool | None" = None
     # held to the SAME schedule — residency must not change what
     # crosses the ICI (no axis_index-based masking, no extra gather of
     # the resident head).
-    for sh_name in ("sharded-mesh2", "sharded-mesh2-cached"):
+    for sh_name in ("sharded-mesh2", "sharded-mesh2-audit",
+                    "sharded-mesh2-cached"):
         sh = manifest["variants"].get(sh_name)
         if sh is not None and sh["collectives"] != ["all_gather"]:
             problems.append(

@@ -81,7 +81,8 @@ _FLOAT_DTYPES = frozenset(
 # invocation passes it explicitly.)
 _CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
                   "faults.py", "devcache.py", "tenancy.py",
-                  "tools/traffic_lab.py", "tools/mesh_chaos.py")
+                  "tools/traffic_lab.py", "tools/mesh_chaos.py",
+                  "tools/sentinel_soak.py")
 _CL004_ALLOWED = {
     "batch.py": frozenset((
         "_shift128_cache", "_key_row_cache", "_host_split_cache",
@@ -111,7 +112,8 @@ _LOCK_CONSTRUCTORS = frozenset(
      "BoundedSemaphore", "Barrier"))
 
 _CL006_MODULES = ("batch.py", "service.py", "tenancy.py",
-                  "tools/traffic_lab.py", "tools/mesh_chaos.py")
+                  "tools/traffic_lab.py", "tools/mesh_chaos.py",
+                  "tools/sentinel_soak.py")
 _CL005_SECRET_ATTRS = frozenset(("s", "prefix"))
 _CL005_SECRET_CALLS = frozenset(("to_bytes", "__bytes__"))
 

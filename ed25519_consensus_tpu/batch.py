@@ -1359,7 +1359,8 @@ class _DeviceLane:
     def healthy(self) -> bool:
         return self._thread.is_alive() and not self._abandoned
 
-    def submit(self, digits, pts, cached=None, tables=None) -> int:
+    def submit(self, digits, pts, cached=None, tables=None,
+               audit: bool = False) -> int:
         """Queue one chunk dispatch.  Cold path: `digits`/`pts` are the
         full staged operands.  Cached path (`cached` = the looked-up
         devcache ResidentKeyset): `pts` is the per-signature R wire and
@@ -1369,11 +1370,14 @@ class _DeviceLane:
         the committed device array from the entry.  `tables` (the
         looked-up kind="tables" entry, single-device only) upgrades the
         cached dispatch to the tables-resident kernel, which skips
-        in-kernel table construction for the head lanes."""
+        in-kernel table construction for the head lanes.  `audit`
+        (cold mesh dispatches only, round 10) runs the sentinel-AUDIT
+        kernel, whose result exposes the per-chip partial sums the
+        host audit inspects."""
         with self._cv:
             cid = self._next_id
             self._next_id += 1
-        self._q.put((cid, digits, pts, cached, tables))
+        self._q.put((cid, digits, pts, cached, tables, audit))
         return cid
 
     def discard(self, cid: int) -> None:
@@ -1441,7 +1445,7 @@ class _DeviceLane:
             item = self._q.get()
             if item is None:
                 return
-            cid, digits, pts, cached, tables = item
+            cid, digits, pts, cached, tables, audit = item
             with self._cv:
                 if cid in self._discarded:
                     # caller already decided on the host (e.g. a leftover
@@ -1504,10 +1508,21 @@ class _DeviceLane:
                         lanes_key = digits.shape[2]
                         n_batches = digits.shape[0]
 
-                        def _call(sh=_sh):
-                            return np.asarray(sh.sharded_window_sums_many(
-                                digits, pts, self._mesh, clock=clock,
-                                **_idkw))
+                        if audit:
+                            # Sentinel-audit form (round 10): same
+                            # sharded MSM, result carries the per-chip
+                            # partials [folded, shard 0, .., shard D-1].
+                            def _call(sh=_sh):
+                                return np.asarray(
+                                    sh.sharded_window_sums_many_audit(
+                                        digits, pts, self._mesh,
+                                        clock=clock, **_idkw))
+                        else:
+                            def _call(sh=_sh):
+                                return np.asarray(
+                                    sh.sharded_window_sums_many(
+                                        digits, pts, self._mesh,
+                                        clock=clock, **_idkw))
                     else:
                         lanes_key = digits.shape[2]
                         n_batches = digits.shape[0]
@@ -1538,11 +1553,13 @@ class _DeviceLane:
                 # subsequent calls are held to the normal deadline.  Each
                 # cached dispatch form is a DIFFERENT executable at the
                 # same lane count, so each completes its own shape key
-                # (0 cold, 1 resident-head, 2 resident-tables).
+                # (0 cold, 1 resident-head, 2 resident-tables, 3
+                # cold-audit — the sentinel kernel compiles separately).
                 _msm.mark_shape_completed(
                     n_batches, lanes_key, self._mesh,
-                    cached=0 if cached is None else (
-                        2 if tables is not None else 1))
+                    cached=3 if (cached is None and audit) else (
+                        0 if cached is None else (
+                            2 if tables is not None else 1)))
             except _faults.LaneDeathSignal:
                 # Injected mid-flight thread death: exit WITHOUT reporting
                 # a result or clearing _started — callers see an in-flight
@@ -1550,12 +1567,15 @@ class _DeviceLane:
                 # over) and healthy() goes False, so the next get()
                 # builds a fresh lane.
                 return
-            except Exception:  # device error: caller decides on host
+            except Exception as e:  # device error: caller decides on host
                 if _config.get("ED25519_TPU_DEBUG"):
                     import traceback
 
                     traceback.print_exc()
                 out = None
+                err = e
+            else:
+                err = None
             # Report the CALL duration (lock acquired → fetch done), not
             # submit-to-finish: with 2 chunks pipelined, queue time would
             # inflate the turnaround EMA ~2× and bench a healthy device.
@@ -1566,7 +1586,10 @@ class _DeviceLane:
                 if cid in self._discarded:
                     self._discarded.discard(cid)
                 else:
-                    self._results[cid] = (out, call_dt)
+                    # The exception object rides to the scheduler for
+                    # typed classification (health.classify_device_error)
+                    # — None on success.
+                    self._results[cid] = (out, call_dt, err)
                 self._cv.notify_all()
 
 
@@ -1782,11 +1805,136 @@ def _merge_groups(verifiers):
     return groups
 
 
+# -- sentinel audits (round 10) -------------------------------------------
+#
+# The sharded MSM path produces per-chip partial Edwards sums before the
+# ICI all-reduce; the sentinel audit samples a dispatched chunk (rate-
+# knobbed), asks the kernel to EXPOSE those partials (the audit-form
+# dispatch), host-recomputes one sampled shard's partial from the exact
+# staged operand bytes, and attributes any divergence to the owning
+# chip.  This is the only machinery that can see the corrupt-sum class
+# with per-chip attribution — including the adversarial reject→accept
+# flip, which host confirmation of device REJECTS structurally cannot
+# (an accept is never re-decided).  The audit is READ-ONLY
+# recomputation: it never edits device output; a distrusted chunk is
+# simply re-decided by the ordinary exact host path, the same rung any
+# device error takes (docs/consensus-invariants.md).
+
+_SENTINEL_SEED = 0x53E4713E1
+
+# One in-flight chunk dispatch as the scheduler tracks it: `variant` is
+# the shape_completed executable tag (0 cold, 1 resident-head, 2
+# resident-tables, 3 cold-audit) and `staged` retains the (digits, pts)
+# operand arrays ONLY for audited chunks (the sentinel's host
+# recomputation input; None otherwise).  A namedtuple so every access
+# site is self-documenting while slicing keeps working.
+import collections as _collections  # noqa: E402
+
+_OutstandingChunk = _collections.namedtuple(
+    "_OutstandingChunk",
+    ("cid", "idxs", "t0", "padded_b", "n_lanes", "variant", "staged"))
+
+
+def _sentinel_fires(rate: float, ordinal: int) -> bool:
+    """Deterministic sampled-audit draw: pure function of the cold
+    sharded dispatch ordinal (plan-replay style — two identical runs
+    audit identical chunks)."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    digest = hashlib.sha256(
+        repr((_SENTINEL_SEED, "sentinel", ordinal)).encode()).digest()
+    return int.from_bytes(digest[:8], "little") / float(1 << 64) < rate
+
+
+def _sentinel_draw(ordinal: int, what: str, n: int) -> int:
+    """Deterministic [0, n) sample for the audited batch/shard pick."""
+    digest = hashlib.sha256(
+        repr((_SENTINEL_SEED, what, ordinal)).encode()).digest()
+    return int.from_bytes(digest[:8], "little") % max(1, n)
+
+
+def _sentinel_digit_planes(digits_b) -> "np.ndarray | None":
+    """One batch's digit planes → MSB-first SIGNED radix-16 planes
+    (NWINDOWS, N) int32, unpacking the nibble wire when present; None
+    when the plane count is not the production radix-16 layout (a
+    kernel-lab variant packing — the audit abstains rather than
+    mis-decode)."""
+    from .ops import limbs
+
+    if digits_b.dtype == np.uint8:  # nibble-packed wire
+        if digits_b.shape[0] != limbs.PACKED_WINDOWS:
+            return None
+        lo = (digits_b & 0xF).astype(np.int32)
+        hi = (digits_b >> 4).astype(np.int32)
+        half = limbs.NWINDOWS // 2  # 16 nibble pairs + the odd carry row
+        planes = np.zeros((limbs.NWINDOWS, digits_b.shape[1]), np.int32)
+        planes[0:2 * half:2] = lo[:half]
+        planes[1:2 * half:2] = hi[:half]
+        planes[2 * half] = lo[half]
+        return np.where(planes >= 8, planes - 16, planes)
+    if digits_b.shape[0] != limbs.NWINDOWS:
+        return None
+    return digits_b.astype(np.int32)
+
+
+def _sentinel_lane_point(pts_b, lane: int):
+    """Decode one lane's point from any device wire (compressed /
+    affine / extended) back to an exact host Point; None when the wire
+    bytes fail decompression (cannot happen for host-staged operands —
+    treated as divergence by the caller)."""
+    from .ops import limbs
+    from .ops.field import P as _P
+
+    if pts_b.dtype == np.uint8:  # compressed wire (33, N)
+        return edwards.decompress(bytes(pts_b[:32, lane]))
+    if pts_b.shape[0] == 2:  # affine X‖Y limbs, Z = 1
+        x = limbs.limbs_to_int(pts_b[0, :, lane]) % _P
+        y = limbs.limbs_to_int(pts_b[1, :, lane]) % _P
+        return edwards.Point(x, y, 1, x * y % _P)
+    coords = [limbs.limbs_to_int(pts_b[c, :, lane]) % _P
+              for c in range(4)]
+    return edwards.Point(*coords)
+
+
+def _sentinel_pmul(pt, v: int):
+    """[v]P for a signed exact integer v (the staged digit planes
+    encode plain integers — lo/hi 128-bit coefficient chunks and
+    blinders — so no modular semantics apply here)."""
+    if v == 0:
+        return edwards.Point(0, 1, 1, 0)
+    if v < 0:
+        return pt.scalar_mul(-v).neg()
+    return pt.scalar_mul(v)
+
+
+def _sentinel_shard_sum(planes, pts_b, lane_lo: int, lane_hi: int):
+    """Host-exact recomputation of one shard's partial MSM sum from
+    the staged operand bytes: Σ [v_lane]P_lane over the shard's lanes
+    (zero-digit padding lanes contribute the identity and skip the
+    point decode).  Returns None when any lane's wire fails to decode
+    — the caller counts that as divergence."""
+    acc = edwards.Point(0, 1, 1, 0)
+    for lane in range(lane_lo, lane_hi):
+        v = 0
+        for w in range(planes.shape[0]):
+            v = (v << 4) + int(planes[w, lane])
+        if not v:
+            continue
+        pt = _sentinel_lane_point(pts_b, lane)
+        if pt is None:
+            return None
+        acc = acc.add(_sentinel_pmul(pt, v))
+    return acc
+
+
 def verify_many(verifiers, rng=None, chunk: int = 8,
                 hybrid: bool = True, merge: str = "auto",
                 mesh: int | None = None,
                 health: "DeviceHealth | None" = None,
-                policy: "_routing.RoutingPolicy | None" = None
+                policy: "_routing.RoutingPolicy | None" = None,
+                sentinel_rate: "float | None" = None
                 ) -> "list[bool]":
     """Verify MANY independent batches with union-merging, chunked
     double-buffered device calls, and an opportunistic host lane.
@@ -1829,7 +1977,17 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     process health_for(mesh).  All scheduling time — deadlines, grace,
     EMA, host-lane medians — runs on that clock, which is what lets
     tests drive the failure machinery with health.FakeClock instead of
-    wall-time bounds."""
+    wall-time bounds.
+
+    `sentinel_rate` (round 10; default the ED25519_TPU_SENTINEL_RATE
+    knob) samples cold sharded chunk dispatches for a sentinel AUDIT:
+    the dispatch returns per-chip partial sums, one sampled shard is
+    host-recomputed from the staged operand bytes, and divergence is
+    attributed to the owning chip (suspicion → the ChipRegistry
+    quarantine ladder).  A chunk whose audit diverges is DISTRUSTED:
+    every one of its batches is re-decided by the exact host path
+    before any verdict publishes — the audit itself never touches the
+    math."""
     from .ops import msm
 
     # Wall-clock for the per-call `seconds` stat only (scheduling time
@@ -1857,7 +2015,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             # MERGED batch sizes — the ones actually dispatched.
             union_verdicts = verify_many(
                 unions, rng=rng, chunk=chunk, hybrid=hybrid,
-                merge="never", mesh=mesh, health=health, policy=policy
+                merge="never", mesh=mesh, health=health, policy=policy,
+                sentinel_rate=sentinel_rate
             )
             stats = dict(last_run_stats)
             verdicts = [False] * len(verifiers)
@@ -1920,11 +2079,18 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # doomed full-width dispatch.  Zero-cost (one empty-set read) and
     # behavior-identical while every chip is healthy, auto-routing
     # included (choose_mesh already resolves to the live rung).
+    # Sentinel sampling rate (round 10): resolved once per call so the
+    # audit decisions are a pure function of the dispatch ordinals.
+    if sentinel_rate is None:
+        sentinel_rate = _config.get("ED25519_TPU_SENTINEL_RATE")
+    sentinel_rate = float(sentinel_rate)
     device_ids = None
     entry_reform = None
     no_device_rung = False
     if (not _config.get("ED25519_TPU_DISABLE_DEVICE")
-            and _health.chip_registry().dead_chips()):
+            and _health.chip_registry().excluded_chips()):
+        # excluded = dead ∪ quarantined ∪ probation (round 10): a
+        # quarantined chip reforms placement exactly like a lost one.
         rung, device_ids = _routing.reform_for(mesh if mesh else 1)
         new_mesh = _health.normalize_mesh(rung)
         if new_mesh != mesh or device_ids is not None:
@@ -1972,6 +2138,18 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # undecided batches were re-issued on the reformed rung).
         "mesh_reformations": [entry_reform] if entry_reform else [],
         "device_ids": list(device_ids) if device_ids else None,
+        # Typed error classification (round 10): how the classifier
+        # binned this call's device errors, and how many chunks the
+        # transient branch retried (bounded backoff) instead of
+        # benching the device.
+        "error_classes": {_health.ERROR_TRANSIENT: 0,
+                          _health.ERROR_FATAL: 0,
+                          _health.ERROR_AMBIGUOUS: 0},
+        "transient_retries": 0,
+        # Sentinel-audit trail (round 10): audited chunk count,
+        # divergences, and the chips divergence attributed.
+        "sentinel": {"rate": sentinel_rate, "audits": 0,
+                     "divergence": 0, "attributed": []},
         "seconds": 0.0,
     }
 
@@ -2228,6 +2406,105 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     # then (budget spent or no rung left) lands on the host — the
     # ladder's floor, never a livelock.
     reforms_left = [4]
+    # Typed-error machinery (round 10): a classified-TRANSIENT chunk
+    # error earns a bounded number of backoff-delayed retries per call
+    # before the ordinary host fallback; the counter (not the delay) is
+    # the liveness bound.  Ordinal counts cold sharded submits for the
+    # deterministic sentinel sampling draw.
+    transient_left = [2]
+    transient_backoff = _health.Backoff(
+        clock=health.clock, base=0.05, factor=2.0, max_delay=0.5,
+        jitter=0.0)
+    _transient_gate = threading.Event()  # never set: a pure bounded wait
+    sentinel_ord = [0]
+
+    def _transient_wait():
+        """The bounded backoff between transient retries: virtual
+        clocks ADVANCE (deterministic tests observe the wait, the
+        StallFor discipline); real clocks wait the armed delay."""
+        delay = transient_backoff.arm()
+        clk = health.clock
+        if getattr(clk, "virtual", False):
+            clk.advance(delay)
+        else:
+            _transient_gate.wait(delay)
+
+    def _placement_chips() -> "tuple[int, ...]":
+        """The chips the CURRENT dispatch shape runs on — what an
+        unattributed (ambiguous) error smears suspicion over, and what
+        an unattributed fatal error marks dead."""
+        if device_ids:
+            return tuple(device_ids)
+        return tuple(range(mesh)) if mesh and mesh > 1 else (0,)
+
+    def _sentinel_check(rec, folded, partials) -> bool:
+        """Audit one audited chunk (read-only recomputation): sample a
+        batch and a shard, host-recompute that shard's partial from
+        the retained staged operands, compare as group elements, and
+        cross-check the fold against the sum of ALL partials.  On any
+        divergence: attribute (per-shard recompute names the chips; a
+        pure fold inconsistency that no shard explains smears
+        ambiguous suspicion over the placement) and return False — the
+        caller re-decides the whole chunk on the host before any
+        verdict publishes."""
+        cid, idxs = rec.cid, rec.idxs
+        digits, pts = rec.staged
+        sen = stats["sentinel"]
+        d_mesh = partials.shape[0]
+        j = _sentinel_draw(cid, "batch", len(idxs))
+        planes = _sentinel_digit_planes(np.asarray(digits[j]))
+        if planes is None:
+            return True  # non-production digit layout: abstain
+        sen["audits"] += 1
+        _metrics.record_fault("sentinel_audit")
+        lanes = planes.shape[1]
+        per_dev = lanes // d_mesh
+        pts_j = np.asarray(pts[j])
+
+        def chip_of(shard: int) -> int:
+            return device_ids[shard] if device_ids else shard
+
+        def shard_diverges(shard: int) -> bool:
+            want = _sentinel_shard_sum(
+                planes, pts_j, shard * per_dev, (shard + 1) * per_dev)
+            got = msm.combine_window_sums(
+                np.asarray(partials[shard, j]))
+            return want is None or want != got
+
+        k = _sentinel_draw(cid, "shard", d_mesh)
+        attributed = []
+        if shard_diverges(k):
+            attributed.append(chip_of(k))
+        else:
+            # Fold consistency: Horner is linear over the shard sums,
+            # so Σ_d combine(partial_d) must equal combine(folded).
+            total = edwards.Point(0, 1, 1, 0)
+            for d in range(d_mesh):
+                total = total.add(msm.combine_window_sums(
+                    np.asarray(partials[d, j])))
+            if total == msm.combine_window_sums(np.asarray(folded[j])):
+                return True
+            # Inconsistent fold: recompute EVERY shard to attribute.
+            attributed = [chip_of(d) for d in range(d_mesh)
+                          if d != k and shard_diverges(d)]
+        sen["divergence"] += 1
+        _metrics.record_fault("sentinel_divergence")
+        chipreg = _health.chip_registry()
+        if attributed:
+            sen["attributed"].extend(attributed)
+            for c in attributed:
+                chipreg.record_suspicion(
+                    c, _health.SENTINEL_SUSPICION,
+                    "sentinel-audit divergence")
+        else:
+            # The fold lies but every shard's partial checks out (a
+            # corrupted collective/fold, not a corrupted chip): no
+            # attribution — ambiguous suspicion over the placement.
+            for c in _placement_chips():
+                chipreg.record_suspicion(
+                    c, _health.AMBIGUOUS_SUSPICION,
+                    "sentinel fold inconsistency (unattributed)")
+        return False
 
     def try_reform(reissue_idxs) -> bool:
         """Chip-loss escalation (round 9): a device failure on a mesh
@@ -2246,7 +2523,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if reforms_left[0] <= 0:
             return False
         chipreg = _health.chip_registry()
-        dead = chipreg.dead_chips()
+        dead = chipreg.excluded_chips()  # dead ∪ quarantined ∪ probation
         if not dead:
             return False
         cur = (mesh if mesh else 1, device_ids)
@@ -2297,7 +2574,17 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if pending is None:
             return
         idxs, digits, pts, cached, tables = pending
-        cid = dev.submit(digits, pts, cached=cached, tables=tables)
+        # Sentinel sampling (round 10): cold SHARDED chunks only — the
+        # audit host-recomputes a shard from the staged wire bytes,
+        # which the cached dispatch forms deliberately keep off the
+        # wire (their corruption class is covered by the devcache hash
+        # re-check + host confirmation instead).
+        audit = False
+        if mesh and mesh > 1 and cached is None:
+            audit = _sentinel_fires(sentinel_rate, sentinel_ord[0])
+            sentinel_ord[0] += 1
+        cid = dev.submit(digits, pts, cached=cached, tables=tables,
+                         audit=audit)
         if cached is not None:
             stats["devcache"]["dispatch_hits"] += 1
         if tables is not None:
@@ -2309,22 +2596,21 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             padded_b, n_lanes = dr.shape[0], dh.shape[2] + dr.shape[2]
         else:
             padded_b, n_lanes = digits.shape[0], digits.shape[2]
-        # (chunk id, real batch idxs, submit time, padded shape (B, N),
-        #  dispatch variant — each cached dispatch form is a different
-        #  executable at the same lane count, so each carries its own
-        #  compile grace: 0 cold, 1 resident-head, 2 resident-tables)
-        variant = 0 if cached is None else (2 if tables is not None
-                                            else 1)
-        outstanding.append((cid, idxs, now(), padded_b, n_lanes,
-                            variant))
+        variant = 3 if audit else (
+            0 if cached is None else (2 if tables is not None else 1))
+        outstanding.append(_OutstandingChunk(
+            cid, idxs, now(), padded_b, n_lanes, variant,
+            (digits, pts) if audit else None))
 
     def poll(block: bool):
         """Apply finished chunk results; returns True if progress.  On a
         deadline miss, fail the device over to the host."""
-        nonlocal device_sick, device_failed, ema_per_batch, ema_is_prior
+        nonlocal device_sick, device_failed, ema_per_batch, \
+            ema_is_prior, probed
         progress = False
         while outstanding:
-            cid, idxs, t0, padded_b, n_lanes, was_cached = outstanding[0]
+            rec = outstanding[0]
+            cid, idxs, t0, padded_b, n_lanes, was_cached = rec[:6]
             budget = max(3.0 * ema_per_batch * padded_b, 2.0)
             if ema_is_prior and not msm.shape_completed(
                     padded_b, n_lanes, mesh or 0, cached=was_cached):
@@ -2375,8 +2661,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 health.note_deadline_miss()  # bench the FAILED rung
                 _metrics.record_fault("deadline_miss")
                 dev.abandon()
-                undecided = [i for _, idxs2, _t, _b, _nl, _c
-                             in outstanding for i in idxs2
+                undecided = [i for r2 in outstanding for i in r2.idxs
                              if not decided[i]]
                 outstanding.clear()
                 if try_reform(undecided):
@@ -2392,25 +2677,70 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                     host_verify_one(i)
                 return True
             outstanding.pop(0)
-            out, call_dt = res
-            if out is None:  # device error: host decides, device benched
+            out, call_dt, err = res
+            if out is None:  # device error: classify, then act
                 stats["device_errors"] += 1
                 _metrics.record_fault("device_error")
+                # Typed classification (round 10): the lane worker
+                # captured the exception; the classifier's rule table
+                # decides the path — never a generic catch-all.
+                ev = _health.classify_device_error(err)
+                stats["error_classes"][ev.cls] += 1
                 undecided = [i for i in idxs if not decided[i]]
-                inflight = [i for _c2, idxs2, _t2, _b2, _nl2, _v2
-                            in outstanding for i in idxs2
+                if (ev.cls == _health.ERROR_TRANSIENT
+                        and transient_left[0] > 0 and not device_failed):
+                    # transient → RETRY with bounded backoff: the
+                    # chunk's undecided batches re-stage (fresh
+                    # blinders, like any re-issue) and re-dispatch on
+                    # the same lane; the retry budget — not the delay —
+                    # bounds liveness.  Exhausting it falls through to
+                    # the ordinary host-fallback ladder below.
+                    transient_left[0] -= 1
+                    stats["transient_retries"] += 1
+                    _metrics.record_fault("device_transient_retry")
+                    _transient_wait()
+                    remaining.extend(undecided)
+                    # Re-arm the probe gate: in hybrid mode the
+                    # pipelined-submit gate needs a MEASURED EMA, which
+                    # an errored probe never produced — without this
+                    # the "retry" would only ever drain host-side.
+                    probed = False
+                    progress = True
+                    continue
+                chipreg = _health.chip_registry()
+                if ev.cls == _health.ERROR_FATAL:
+                    # fatal → the named chips (or, unattributed, the
+                    # whole placement) are DEAD; the reformation ladder
+                    # below reforms around them.  Chips the raiser
+                    # already marked keep their heal window.
+                    if not ev.marked:
+                        for c in (ev.chips or _placement_chips()):
+                            chipreg.mark_chip_dead(
+                                c, heal_after=ev.heal_after,
+                                reason=f"classified-fatal: {ev.reason}")
+                    _metrics.record_fault("device_fatal_classified")
+                elif ev.cls == _health.ERROR_AMBIGUOUS:
+                    # ambiguous → SUSPICION, smeared over the placement
+                    # (any chip of the mesh could be the cause); the
+                    # decaying ledger — not this one error — decides
+                    # whether a chip ever leaves placement.
+                    for c in _placement_chips():
+                        chipreg.record_suspicion(
+                            c, _health.AMBIGUOUS_SUSPICION,
+                            f"ambiguous device error: {ev.reason}")
+                inflight = [i for r2 in outstanding for i in r2.idxs
                             if not decided[i]]
                 old_dev = dev
                 if try_reform(undecided + inflight):
-                    # Chip loss mid-wave (the error came from a mesh
-                    # with a chip marked dead): the failed chunk AND
-                    # every chunk still queued on the degraded lane
-                    # re-issue on the reformed rung.  The old lane is
-                    # healthy as a thread — just pointed at a dead
-                    # mesh — so its leftover results are discarded,
-                    # not waited for.
-                    for c2, _i2, _t2, _b2, _nl2, _v2 in outstanding:
-                        old_dev.discard(c2)
+                    # Chip loss/quarantine mid-wave (the error came
+                    # from a mesh with an excluded chip): the failed
+                    # chunk AND every chunk still queued on the
+                    # degraded lane re-issue on the reformed rung.
+                    # The old lane is healthy as a thread — just
+                    # pointed at a dead mesh — so its leftover results
+                    # are discarded, not waited for.
+                    for r2 in outstanding:
+                        old_dev.discard(r2.cid)
                     outstanding.clear()
                     return True
                 device_failed = True  # don't trust an error turnaround as
@@ -2418,6 +2748,44 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 for i in idxs:
                     host_verify_one(i)
             else:
+                if was_cached == 3:
+                    # Audited sharded chunk (round 10): the result is
+                    # [folded, per-shard partials].  Run the sentinel
+                    # BEFORE any verdict can publish; a diverging
+                    # audit distrusts the WHOLE chunk — every batch is
+                    # re-decided by the exact host path (the same rung
+                    # any device error takes), so not even a crafted
+                    # reject→accept flip can survive an audited wave.
+                    folded, partials = out[0], out[1:]
+                    if not _sentinel_check(rec, folded, partials):
+                        for i in idxs:
+                            host_verify_one(i)
+                        progress = True
+                        # If the audit's attribution just QUARANTINED a
+                        # chip of THIS placement, the rest of the call
+                        # must not keep dispatching on the diagnosed
+                        # mesh — with a sampled rate (< 1.0) later
+                        # unaudited chunks would republish exactly the
+                        # corruption the audit caught.  Reform and
+                        # re-issue the still-queued chunks, precisely
+                        # the classified-fatal dance.
+                        excl = _health.chip_registry().excluded_chips()
+                        if excl and excl & set(_placement_chips()):
+                            inflight = [i for r2 in outstanding
+                                        for i in r2.idxs
+                                        if not decided[i]]
+                            old_dev = dev
+                            if try_reform(inflight):
+                                for r2 in outstanding:
+                                    old_dev.discard(r2.cid)
+                                outstanding.clear()
+                                return True
+                            # No reformable rung left (or budget
+                            # spent): the placement is diagnosed
+                            # corrupt — bench the device, host floor.
+                            device_failed = True
+                        continue
+                    out = folded
                 # EMA over the device CALL time (the lane worker measures
                 # it) per PADDED batch — a padded probe pays exactly a
                 # full chunk's kernel, so this is the steady-state
@@ -2503,8 +2871,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         # to trusting the device (with the normal short deadline).
         grace_hybrid = (not hybrid and ema_is_prior and outstanding
                         and not msm.shape_completed(
-                            outstanding[0][3], outstanding[0][4],
-                            mesh or 0, cached=outstanding[0][5]))
+                            outstanding[0].padded_b,
+                            outstanding[0].n_lanes,
+                            mesh or 0, cached=outstanding[0].variant))
         lane_hybrid = hybrid or grace_hybrid
         # host lane: steal one batch from the tail, then re-poll
         if lane_hybrid and remaining and outstanding:
@@ -2518,7 +2887,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 # the math is identical either way.
                 stole = False
                 for ci in range(len(outstanding) - 1, -1, -1):
-                    cid, idxs, _t0, padded_b, _nl, _c = outstanding[ci]
+                    cid, idxs, _t0, padded_b, _nl, _c = \
+                        outstanding[ci][:6]
                     undecided = [i for i in idxs if not decided[i]]
                     if undecided:
                         host_verify_one(undecided[-1])
@@ -2548,7 +2918,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                                 # not 2x it
                                 res = dev.wait(cid, grace - elapsed)
                                 if res is not _PENDING:
-                                    out, call_dt = res
+                                    out, call_dt, _err = res
                                     if out is not None:
                                         ema_per_batch = call_dt / max(
                                             1, padded_b)
@@ -2629,6 +2999,67 @@ def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
                 msm.mark_shape_completed(chunk, ddc.shape[2], cached=2)
     except Exception:
         return  # same contract: cached warming is optional
+
+
+def run_probation_probe(verifier, chip: int, rng=None) -> "bool | None":
+    """One LOW-STAKES probation probe on a quarantined-then-eligible
+    chip (round 10): stage `verifier`'s batch on the host, dispatch its
+    MSM as a single-device call PLACED ON `chip`, and compare the
+    device window sums — combined in exact host integers — against the
+    exact host MSM over the same staged terms, as group elements.
+
+    * a matching sum records a probation PASS (returns True; after
+      ED25519_TPU_PROBATION_PROBES consecutive passes the registry
+      rejoins the chip and the next routing read reforms over it);
+    * a diverging sum — or ANY dispatch failure — records a probation
+      FAIL (returns False): straight back to quarantine with fresh
+      suspicion, so a genuinely-corrupting chip stays out;
+    * None means the probe could not run at all (staging rejected the
+      batch, or no device backend) — no evidence either way, nothing
+      recorded.
+
+    The probe is low-stakes by construction: its verifier is probe
+    traffic the caller supplies (tools/sentinel_soak.py, an operator
+    runbook), never production work, and the probe's verdict
+    machinery is the exact host math — the chip under probation never
+    decides anything.  Direct dispatch (under DEVICE_CALL_LOCK, not
+    through a lane) keeps the production lane registry untouched."""
+    reg = _health.chip_registry()
+    try:
+        staged = verifier._stage(rng)
+    except InvalidSignature:
+        return None  # probe traffic must stage; no evidence either way
+    try:
+        from .ops import msm
+    except ImportError:
+        return None
+    expected = staged.host_msm()
+    try:
+        pad = msm.preferred_pad(staged.n_device_terms)
+        d, p = staged.device_operands(lambda n: pad)
+        import jax
+
+        with msm.DEVICE_CALL_LOCK:
+            with jax.default_device(jax.devices()[int(chip)]):
+                out = np.asarray(
+                    msm.dispatch_window_sums_many(d[None], p[None]))
+        got = msm.combine_window_sums(out[0])
+    except Exception:
+        # Probe supervision: any failure to produce a comparable sum IS
+        # the probe's evidence (an erroring chip is not a clean chip) —
+        # recorded as a fail, never propagated.
+        reg.record_probation_fail(chip, reason="probe dispatch failed")
+        _metrics.record_fault("probation_probe_failed")
+        return False
+    if got == expected:
+        rejoined = reg.record_probation_pass(chip)
+        _metrics.record_fault("probation_probe_passed")
+        if rejoined:
+            _metrics.record_fault("chip_rejoined")
+        return True
+    reg.record_probation_fail(chip, reason="probe sum divergence")
+    _metrics.record_fault("probation_probe_failed")
+    return False
 
 
 def verify_single_many(entries, rng=None) -> "list[bool]":

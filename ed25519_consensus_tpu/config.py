@@ -208,6 +208,32 @@ KNOBS: "dict[str, Knob]" = dict([
     _k("ED25519_TPU_MESH_CHAOS_SEED", "int", 0xC41905,
        "Default seed for tools/mesh_chaos.py's chip-loss storms and "
        "workload construction (the run is a pure function of it)."),
+    _k("ED25519_TPU_SENTINEL_RATE", "float", 0.0,
+       "Sampled sentinel-audit rate over cold sharded chunk dispatches "
+       "(0..1): an audited wave returns per-chip partial sums, one "
+       "sampled shard is host-recomputed from the staged operands, and "
+       "any divergence is attributed to the owning chip; 0 (default) "
+       "disables auditing."),
+    _k("ED25519_TPU_SUSPICION_THRESHOLD", "float", 3.0,
+       "Per-chip decayed suspicion score at which the ChipRegistry "
+       "QUARANTINES a chip (sentinel divergences weigh 1.5, ambiguous "
+       "dispatch errors 0.25 per placement chip)."),
+    _k("ED25519_TPU_SUSPICION_HALF_LIFE", "float", 300.0,
+       "Half-life (registry-clock seconds) of per-chip suspicion "
+       "scores; decay below half the threshold relaxes quarantine to "
+       "probation eligibility."),
+    _k("ED25519_TPU_PROBATION_PROBES", "int", 3,
+       "Consecutive clean host-verified probe chunks a probation chip "
+       "must pass (batch.run_probation_probe) before it rejoins "
+       "production placement."),
+    _k("ED25519_TPU_QUARANTINE", "opt-out", True,
+       "Set to 0/false/no to make the chip-suspicion ledger "
+       "report-only: scores still accumulate and decay, but no chip "
+       "is ever quarantined (placement never changes)."),
+    _k("ED25519_TPU_SENTINEL_SOAK_SEED", "int", 0x5E47,
+       "Default seed for tools/sentinel_soak.py's corrupting-chip "
+       "storms and workload construction (the run is a pure function "
+       "of it)."),
 ])
 
 

@@ -272,6 +272,11 @@ class DeviceOperandCache:
             "restage_hash_mismatch": 0, "stale_epoch": 0,
             "builds": 0, "drops": 0, "tenant_rotations": 0,
             "quota_rejected": 0, "chip_drops": 0,
+            # Round 10: the chip_drops subset whose trigger was the
+            # suspicion ledger's QUARANTINE (not a reported loss) —
+            # same listener path, same per-shard semantics, separate
+            # tally so an operator can tell diagnosis from disaster.
+            "quarantine_drops": 0,
         }
         # per-tenant hit/miss/eviction/staleness tallies (tenant ->
         # counter dict), the fairness numbers the traffic lab and the
@@ -409,6 +414,8 @@ class DeviceOperandCache:
                           for e in self._entries.values())
             if dropped:
                 self.counters["chip_drops"] += dropped
+                if "quarantine" in reason:
+                    self.counters["quarantine_drops"] += dropped
         if dropped:
             _metrics.record_fault("devcache_chip_drop", dropped)
         self._publish()

@@ -54,12 +54,14 @@ import numpy as np
 
 __all__ = [
     "SITE_LANE", "SITE_SHARDED", "SITE_DEVCACHE", "InjectedFault",
+    "TransientDispatchError", "FatalChipError",
     "LaneDeathSignal",
-    "Fault", "ErrorOn", "StallFor", "FlappingLink", "CorruptSum",
+    "Fault", "ErrorOn", "TypedErrorOn", "StallFor", "FlappingLink",
+    "CorruptSum", "CorruptChipSum",
     "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
     "RotateTenant", "ChipLoss", "LinkFlap",
     "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
-    "mesh_plan",
+    "mesh_plan", "sentinel_plan", "typed_error_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
 ]
@@ -74,7 +76,37 @@ SITE_DEVCACHE = "devcache"
 
 class InjectedFault(RuntimeError):
     """The error an injected device fault raises (so tests and the chaos
-    driver can tell injected failures from real ones in logs)."""
+    driver can tell injected failures from real ones in logs).  Carries
+    NO `device_error_class` marker: a plain injected error is exactly
+    the undifferentiated failure the classifier's AMBIGUOUS bucket
+    exists for (health.classify_device_error)."""
+
+
+class TransientDispatchError(InjectedFault):
+    """A typed TRANSIENT dispatch error (link hiccup / retryable-timeout
+    shape): the scheduler's classifier retries the chunk with bounded
+    backoff before benching anything.  The marker attribute is the
+    classification seam — a real PJRT/ICI error shim declares the same
+    attribute."""
+
+    device_error_class = "transient"
+
+
+class FatalChipError(InjectedFault):
+    """A typed FATAL dispatch error naming the chips that are gone: the
+    classifier marks them dead in the ChipRegistry (unless the raiser
+    already did — `chips_marked`, the fault-seam convention, which
+    also preserves the raiser's heal window) and the reformation
+    ladder reforms around them."""
+
+    device_error_class = "fatal"
+
+    def __init__(self, msg: str, chips=(), heal_after: "float | None" = None,
+                 chips_marked: bool = False):
+        super().__init__(msg)
+        self.chips = tuple(int(c) for c in chips)
+        self.heal_after = heal_after
+        self.chips_marked = bool(chips_marked)
 
 
 class LaneDeathSignal(Exception):
@@ -129,6 +161,52 @@ class ErrorOn(Fault):
     def before(self, ctx):
         raise InjectedFault(
             f"injected device error (site={ctx.site}, call={ctx.index})")
+
+
+class TypedErrorOn(Fault):
+    """Typed-exception injection (round 10): raise one of the
+    classifier's input shapes at the faulted calls, so EVERY branch of
+    health.classify_device_error is testable end to end —
+
+    * ``kind="transient"`` — TransientDispatchError (retry branch);
+    * ``kind="fatal"``     — FatalChipError naming `chips` (mark-dead
+      branch; `heal_after` rides to the registry mark);
+    * ``kind="ambiguous"`` — plain InjectedFault (suspicion branch);
+    * ``kind="timeout"`` / ``kind="oserror"`` — the stdlib types the
+      rule table matches structurally (TimeoutError / ConnectionError),
+      for the non-marker rows."""
+
+    def __init__(self, kind: str = "transient", on=0,
+                 site: str = SITE_LANE, chips=(),
+                 heal_after: "float | None" = None):
+        if kind not in ("transient", "fatal", "ambiguous", "timeout",
+                        "oserror"):
+            raise ValueError(f"unknown typed-error kind {kind!r}")
+        super().__init__(on=on, site=site)
+        self.error_kind = kind
+        self.chips = tuple(int(c) for c in chips)
+        self.heal_after = heal_after
+
+    def kind(self) -> str:
+        return f"TypedErrorOn[{self.error_kind}]"
+
+    def before(self, ctx):
+        where = f"(site={ctx.site}, call={ctx.index})"
+        if self.error_kind == "transient":
+            raise TransientDispatchError(
+                f"injected transient dispatch error {where}")
+        if self.error_kind == "fatal":
+            raise FatalChipError(
+                f"injected fatal chip error: chips "
+                f"{list(self.chips)} {where}",
+                chips=self.chips, heal_after=self.heal_after)
+        if self.error_kind == "timeout":
+            raise TimeoutError(f"injected dispatch timeout {where}")
+        if self.error_kind == "oserror":
+            raise ConnectionResetError(
+                f"injected link reset {where}")
+        raise InjectedFault(
+            f"injected ambiguous device error {where}")
 
 
 class StallFor(Fault):
@@ -206,6 +284,87 @@ class CorruptSum(Fault):
         return arr
 
 
+class CorruptChipSum(Fault):
+    """ONE chip silently corrupts ITS partial Edwards sum (round 10) —
+    the failure class the sentinel audits exist to detect, which the
+    round-2 CorruptSum (whole-result corruption) cannot model: here the
+    call completes, the fold is poisoned by exactly one shard, and
+    without per-chip attribution every wave the chip touches fails
+    device-side while the mesh looks healthy.
+
+    On a plain sharded result (B, 4, NLIMBS, nwin) the fault flips
+    entries per batch slice, exactly like CorruptSum — the corrupt
+    partial poisons the fold.  On an AUDIT-form result
+    (1+D, B, 4, NLIMBS, nwin; folded first, then per-shard partials)
+    it corrupts the folded rows AND shard `chip`'s partial rows, so the
+    sentinel's host recomputation of that shard diverges and the
+    divergence attributes to the owning chip.
+
+    ``flip_accept=True`` is the ADVERSARIAL variant: instead of random
+    flips the result is overwritten with identity window sums — the
+    device then claims ACCEPT for every batch, including ones that
+    should reject.  Host confirmation of device REJECTS can never see
+    this direction; only the sentinel audit can (the regression pin in
+    tests/test_faults.py)."""
+
+    def __init__(self, chip: int, on=0, site: str = SITE_SHARDED,
+                 flips: int = 4, flip_accept: bool = False):
+        super().__init__(on=on, site=site)
+        self.chip = int(chip)
+        self.flips = int(flips)
+        self.flip_accept = bool(flip_accept)
+
+    def kind(self) -> str:
+        return ("CorruptChipSum[accept]" if self.flip_accept
+                else "CorruptChipSum")
+
+    def _shard_of(self, ctx) -> "int | None":
+        """The corrupting chip's shard index in THIS call's placement
+        (the sharded seams pass device_ids as ctx.payload; None =
+        canonical prefix), or None when the chip is not in the
+        collective at all — a quarantined/reformed-out chip physically
+        cannot corrupt a collective it no longer participates in."""
+        ids = (tuple(ctx.payload) if ctx.payload
+               else tuple(range(ctx.mesh or 1)))
+        return ids.index(self.chip) if self.chip in ids else None
+
+    @staticmethod
+    def _identity_sums(slot) -> None:
+        """Overwrite one (4, NLIMBS, nwin) window-sum slot (or a batch
+        of them) with the identity point's limbs per window: Horner
+        over identities combines to the identity, i.e. device ACCEPT."""
+        slot[...] = 0
+        slot[..., 1, 0, :] = 1  # Y limb 0
+        slot[..., 2, 0, :] = 1  # Z limb 0
+
+    def _flip_rows(self, arr, rng) -> None:
+        rows = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
+            else arr.reshape(1, -1)
+        for row in rows:
+            for _ in range(max(1, self.flips)):
+                row[rng.randrange(row.size)] ^= 1 << rng.randrange(12)
+
+    def after(self, ctx, out):
+        shard = self._shard_of(ctx)
+        if shard is None:
+            return out  # the chip is not in this collective
+        arr = np.array(out, copy=True)
+        rng = random.Random(_stable_seed(
+            ctx.plan.seed, ctx.site, ctx.index, "chip-corrupt",
+            self.chip))
+        if arr.ndim == 5:
+            # audit form: corrupt the fold AND the chip's own partial
+            targets = [arr[0], arr[1 + shard]]
+        else:
+            targets = [arr]
+        for t in targets:
+            if self.flip_accept:
+                self._identity_sums(t)
+            else:
+                self._flip_rows(t, rng)
+        return arr
+
+
 class KillLane(Fault):
     """Kill the lane worker mid-flight.  `advance` pre-advances a
     virtual clock (so the orphaned in-flight chunk's deadline expires
@@ -255,9 +414,16 @@ class ChipLoss(Fault):
                 c, heal_after=self.heal_after,
                 reason=f"injected chip loss (site={ctx.site}, "
                        f"call={ctx.index})")
-        raise InjectedFault(
+        # Typed raise (round 10): a chip loss IS the fatal class, and
+        # the marker keeps the classifier from smearing ambiguous
+        # suspicion over healthy placement chips.  chips_marked=True —
+        # the registry marks above carry the heal window; the
+        # classifier must not re-mark them permanent.
+        raise FatalChipError(
             f"injected chip loss: chips {list(self.chips)} died "
-            f"mid-wave (site={ctx.site}, call={ctx.index})")
+            f"mid-wave (site={ctx.site}, call={ctx.index})",
+            chips=self.chips, heal_after=self.heal_after,
+            chips_marked=True)
 
 
 class LinkFlap(Fault):
@@ -294,9 +460,11 @@ class LinkFlap(Fault):
                 self.chip, heal_after=self.heal_after,
                 reason=f"injected link flap (site={ctx.site}, "
                        f"call={ctx.index})")
-            raise InjectedFault(
+            raise FatalChipError(
                 f"flapping ICI link down: chip {self.chip} "
-                f"(site={ctx.site}, call={ctx.index})")
+                f"(site={ctx.site}, call={ctx.index})",
+                chips=(self.chip,), heal_after=self.heal_after,
+                chips_marked=True)
         reg.heal_chip(self.chip)
 
 
@@ -583,6 +751,50 @@ def mesh_plan(seed: int, kind: str, chips=(0,), at: int = 0,
     else:
         raise ValueError(f"unknown mesh fault kind {kind!r}")
     return FaultPlan(faults, seed=seed)
+
+
+def sentinel_plan(seed: int, kind: str, chip: int = 0, on=None,
+                 at: int = 0, length: int = 1, flips: int = 4,
+                 site: str = SITE_SHARDED) -> FaultPlan:
+    """A per-chip corruption schedule for the sentinel-audit subsystem
+    (tools/sentinel_soak.py replays these from a seed):
+
+    * ``"corrupt-chip"`` — chip `chip` silently corrupts its partial
+      Edwards sum at the faulted sharded calls (deterministic flips);
+    * ``"flip-accept"``  — the adversarial direction: the result is
+      overwritten with identity window sums, turning every batch —
+      should-reject ones included — into a device ACCEPT, which only
+      the sentinel audit can catch.
+
+    `on` overrides the default contiguous [at, at+length) window with
+    any membership spec (int / iterable / callable), e.g. `on=lambda
+    i: True` for a persistently-corrupting chip.  Same replay property
+    as every other plan: decisions are pure functions of (seed, site,
+    call index)."""
+    window = on if on is not None else range(at, at + max(1, length))
+    if kind == "corrupt-chip":
+        faults = [CorruptChipSum(chip, on=window, flips=flips,
+                                 site=site)]
+    elif kind == "flip-accept":
+        faults = [CorruptChipSum(chip, on=window, flip_accept=True,
+                                 site=site)]
+    else:
+        raise ValueError(f"unknown sentinel fault kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
+def typed_error_plan(seed: int, kind: str, at: int = 0, length: int = 1,
+                     chips=(), heal_after: "float | None" = None,
+                     site: str = SITE_LANE) -> FaultPlan:
+    """A typed-exception window over a dispatch stream — the classifier
+    suite's input (health.classify_device_error): every call in
+    [at, at+length) raises the `kind` shape (TypedErrorOn kinds:
+    transient / fatal / ambiguous / timeout / oserror)."""
+    window = range(at, at + max(1, length))
+    return FaultPlan(
+        [TypedErrorOn(kind, on=window, chips=chips,
+                      heal_after=heal_after, site=site)],
+        seed=seed)
 
 
 # -- the process-wide injection point -------------------------------------

@@ -41,6 +41,8 @@ import hashlib
 import threading
 import time
 
+from . import config as _config
+
 __all__ = [
     "Clock", "FakeClock", "SYSTEM_CLOCK", "DeviceHealth", "Backoff",
     "ChipRegistry", "chip_registry",
@@ -48,7 +50,109 @@ __all__ = [
     "set_any_lane_stuck", "register_residency_drop_listener",
     "notify_residency_drop", "register_chip_drop_listener",
     "notify_chip_drop",
+    "ERROR_TRANSIENT", "ERROR_FATAL", "ERROR_AMBIGUOUS",
+    "ErrorVerdict", "classify_device_error",
+    "STATE_HEALTHY", "STATE_SUSPECTED", "STATE_QUARANTINED",
+    "STATE_PROBATION", "SENTINEL_SUSPICION", "AMBIGUOUS_SUSPICION",
 ]
+
+
+# -- typed error classification (round 10) ---------------------------------
+#
+# Until this round every dispatch-time exception took ONE undifferentiated
+# path: the lane worker swallowed it, the chunk's batches fell to the
+# host, and the device was benched wholesale.  The classifier turns the
+# exception into a typed verdict the scheduler can act on:
+#
+# * TRANSIENT — a link hiccup / timeout shape: the chunk is worth a
+#   bounded-backoff RETRY on the same lane before anything is benched.
+# * FATAL     — the error names chips that are gone (ICI neighbor lost,
+#   runtime says the device died): mark them dead in the ChipRegistry
+#   so the existing reformation ladder reforms around them.
+# * AMBIGUOUS — everything unrecognized.  Ambiguity is itself a CLASS,
+#   not a catch-all shortcut: the outcome is SUSPICION (a decaying
+#   per-chip score in the ChipRegistry), never a retry and never a
+#   chip death — the scheduler keeps today's host-fallback behavior
+#   and the suspicion ledger decides, over evidence, whether a chip
+#   earns quarantine.
+#
+# The rule table is explicit types/markers only.  No branch infers
+# "transient" or "fatal" from a generic Exception — an unrecognized
+# error can only ever land in the designated AMBIGUOUS bucket (the
+# acceptance bar: no classification outcome derived from a catch-all).
+
+ERROR_TRANSIENT = "transient"
+ERROR_FATAL = "fatal"
+ERROR_AMBIGUOUS = "ambiguous"
+
+_ERROR_CLASSES = (ERROR_TRANSIENT, ERROR_FATAL, ERROR_AMBIGUOUS)
+
+
+class ErrorVerdict:
+    """One classified dispatch error: the class, the chips the error
+    attributes (FATAL only; empty = the caller's current placement),
+    whether those chips were ALREADY marked dead by the raiser (the
+    fault seams mark at the raise site — the scheduler must not
+    re-mark a transient loss as permanent), the raiser's heal window,
+    and a short reason for logs/suspicion ledgers."""
+
+    __slots__ = ("cls", "chips", "marked", "heal_after", "reason")
+
+    def __init__(self, cls, chips=(), marked=False, heal_after=None,
+                 reason=""):
+        self.cls = cls
+        self.chips = tuple(int(c) for c in chips)
+        self.marked = bool(marked)
+        self.heal_after = heal_after
+        self.reason = reason
+
+    def __repr__(self):
+        return (f"ErrorVerdict(cls={self.cls!r}, chips={self.chips!r}, "
+                f"marked={self.marked}, reason={self.reason!r})")
+
+
+def classify_device_error(err) -> ErrorVerdict:
+    """Map one dispatch-time exception to {transient, fatal, ambiguous}.
+
+    The rule table, in order — every branch matches a SPECIFIC type or
+    an explicitly-declared marker, never a generic Exception test:
+
+    1. ``device_error_class`` marker — an exception (a faults.py typed
+       injection, or a future real PJRT/ICI classifier shim) DECLARES
+       its class; ``chips``/``chips_marked``/``heal_after`` attributes
+       carry the fatal attribution.  An invalid marker value is itself
+       AMBIGUOUS (a lying classifier is an unclassified failure).
+    2. ``TimeoutError`` — transient by nature: the call may complete on
+       a retry (deadline misses never reach here; they have no
+       exception and walk the stall ladder).
+    3. ``ConnectionError`` / ``OSError`` — a tunneled-device link
+       hiccup: transient (a retry re-opens the stream; persistent link
+       death keeps erroring and exhausts the bounded retry budget).
+    4. anything else (``None`` included — legacy paths with no
+       exception context) — AMBIGUOUS, the designated bucket whose
+       OUTCOME is suspicion.  This is the one intentional default and
+       it never yields a retry or a chip death."""
+    marker = getattr(err, "device_error_class", None)
+    if marker is not None:
+        if marker in _ERROR_CLASSES:
+            return ErrorVerdict(
+                marker,
+                chips=getattr(err, "chips", ()) or (),
+                marked=bool(getattr(err, "chips_marked", False)),
+                heal_after=getattr(err, "heal_after", None),
+                reason=f"declared:{type(err).__name__}")
+        return ErrorVerdict(
+            ERROR_AMBIGUOUS,
+            reason=f"invalid-marker:{marker!r}:{type(err).__name__}")
+    if isinstance(err, TimeoutError):
+        return ErrorVerdict(ERROR_TRANSIENT, reason="timeout")
+    if isinstance(err, (ConnectionError, OSError)):
+        return ErrorVerdict(ERROR_TRANSIENT,
+                            reason=f"link:{type(err).__name__}")
+    if err is None:
+        return ErrorVerdict(ERROR_AMBIGUOUS, reason="no-exception-context")
+    return ErrorVerdict(ERROR_AMBIGUOUS,
+                        reason=f"unclassified:{type(err).__name__}")
 
 
 def normalize_mesh(mesh) -> int:
@@ -179,6 +283,22 @@ def notify_chip_drop(chip: int, reason: str) -> None:
             pass
 
 
+# Suspicion weights (round 10).  A sentinel-audit divergence is STRONG
+# evidence (the host re-derived the chip's own partial sum from the
+# staged bytes and it disagreed) — two divergences cross the default
+# threshold.  An ambiguous dispatch error is WEAK evidence smeared over
+# the whole placement (any chip of the mesh could have caused it) — it
+# takes a sustained pattern, not a bad afternoon, to quarantine a chip
+# on ambiguity alone.
+SENTINEL_SUSPICION = 1.5
+AMBIGUOUS_SUSPICION = 0.25
+
+STATE_HEALTHY = "healthy"
+STATE_SUSPECTED = "suspected"
+STATE_QUARANTINED = "quarantined"
+STATE_PROBATION = "probation"
+
+
 class ChipRegistry:
     """Process-wide liveness of the PHYSICAL accelerator chips (device
     indices as jax enumerates them) — the input the round-9 mesh
@@ -207,12 +327,63 @@ class ChipRegistry:
     guesses it from a generic device error, so no existing failure
     path changes behavior unless a chip was explicitly marked.  Same
     thread contract as DeviceHealth: every field under the lock, no
-    call-outs (listeners run outside), all timestamps from `clock`."""
+    call-outs (listeners run outside), all timestamps from `clock`.
+
+    Round 10 adds the DIAGNOSED side: per-chip decaying SUSPICION
+    scores and the quarantine → probation → rejoin state machine.
+
+    * `record_suspicion(chip, weight, reason)` — evidence lands:
+      sentinel-audit divergence (SENTINEL_SUSPICION, attributed to one
+      chip), ambiguous dispatch errors (AMBIGUOUS_SUSPICION, smeared
+      over the placement).  Scores decay with a half-life
+      (ED25519_TPU_SUSPICION_HALF_LIFE, registry clock), so stale
+      evidence evaporates; crossing ED25519_TPU_SUSPICION_THRESHOLD
+      QUARANTINES the chip — the same chip-drop listeners fire as for
+      a chip loss (devcache drops exactly its device-side residency)
+      and the chip leaves `excluded_chips()`-reading placements.
+      ED25519_TPU_QUARANTINE=0 keeps the ledger report-only.
+    * Quarantine relaxes to PROBATION on the read side once the score
+      decays below half the threshold (no daemon — like heal windows,
+      probation eligibility is a read).  A probation chip stays OUT of
+      production placement; `record_probation_pass` (driven by
+      host-verified probe chunks, batch.run_probation_probe) rejoins
+      it after ED25519_TPU_PROBATION_PROBES consecutive clean probes,
+      `record_probation_fail` re-quarantines with fresh suspicion — a
+      genuinely-corrupting chip keeps failing probes and stays out; a
+      transiently-flapped one decays, probes clean, and returns.
+    * `excluded_chips()` = dead ∪ quarantined ∪ probation — what
+      routing/scheduler placement must avoid.  `dead_chips()` keeps
+      its round-9 meaning (reported liveness only).
+
+    Suspicion and quarantine gate PLACEMENT, never math: no verdict
+    path reads them (docs/consensus-invariants.md)."""
 
     def __init__(self, clock: "Clock | None" = None):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._lock = threading.Lock()
         self._dead = {}  # chip index -> heal-at time (inf = permanent)
+        # Round 10 — the diagnosed ledger: chip -> [score, stamp]
+        # (score as of stamp; decayed lazily on read/update), chip ->
+        # STATE_QUARANTINED | STATE_PROBATION (absent = healthy or
+        # merely suspected), chip -> consecutive clean probation
+        # probes.
+        self._suspicion = {}
+        self._state = {}
+        self._probation_passes = {}
+
+    # -- knobs (live reads through the config registry) -------------------
+
+    @staticmethod
+    def _threshold() -> float:
+        return _config.get("ED25519_TPU_SUSPICION_THRESHOLD")
+
+    @staticmethod
+    def _half_life() -> float:
+        return _config.get("ED25519_TPU_SUSPICION_HALF_LIFE")
+
+    @staticmethod
+    def _probes_needed() -> int:
+        return _config.get("ED25519_TPU_PROBATION_PROBES")
 
     def set_clock(self, clock: "Clock | None") -> None:
         """Inject the registry's time source (tests / the chaos lab
@@ -243,38 +414,218 @@ class ChipRegistry:
             self._dead.clear()
 
     def dead_chips(self) -> "frozenset[int]":
-        """The currently-dead chip indices; reading prunes every healed
-        window (rejoin is a read-side transition — no daemon)."""
+        """The currently-dead chip indices (REPORTED liveness only —
+        quarantine is separate, see `excluded_chips`); reading prunes
+        every healed window (rejoin is a read-side transition — no
+        daemon)."""
         with self._lock:
-            now = self.clock.monotonic()
-            healed = [c for c, t in self._dead.items() if now >= t]
-            for c in healed:
-                del self._dead[c]
+            self._prune_dead_locked()
             return frozenset(self._dead)
 
+    def _prune_dead_locked(self) -> None:
+        now = self.clock.monotonic()
+        for c in [c for c, t in self._dead.items() if now >= t]:
+            del self._dead[c]
+
+    # -- suspicion ledger + quarantine ladder (round 10) -------------------
+
+    def _decayed_locked(self, chip: int, now: float) -> float:
+        rec = self._suspicion.get(chip)
+        if rec is None:
+            return 0.0
+        score, stamp = rec
+        hl = self._half_life()
+        if hl > 0 and now > stamp:
+            score *= 0.5 ** ((now - stamp) / hl)
+        rec[0], rec[1] = score, now
+        if score < 1e-6:
+            del self._suspicion[chip]
+            return 0.0
+        return score
+
+    def _prune_quarantine_locked(self, now: float) -> None:
+        """Read-side quarantine → probation relaxation: once a
+        quarantined chip's suspicion has decayed below HALF the
+        threshold (hysteresis — re-quarantine needs fresh evidence,
+        not clock jitter), it becomes a probation candidate.  Like
+        heal windows, eligibility is a read, not a daemon."""
+        half = self._threshold() * 0.5
+        for c, st in list(self._state.items()):
+            if st == STATE_QUARANTINED \
+                    and self._decayed_locked(c, now) <= half:
+                self._state[c] = STATE_PROBATION
+                self._probation_passes[c] = 0
+
+    def suspicion(self, chip: int) -> float:
+        """The chip's current (decayed) suspicion score."""
+        with self._lock:
+            return self._decayed_locked(int(chip),
+                                        self.clock.monotonic())
+
+    def record_suspicion(self, chip: int, weight: float,
+                         reason: str = "suspicion") -> str:
+        """Land one piece of evidence against `chip`: decay-update its
+        score, add `weight`; crossing the threshold QUARANTINES the
+        chip (unless ED25519_TPU_QUARANTINE=0 keeps the ledger
+        report-only) — the chip-drop listeners fire exactly as for a
+        chip loss, so devcache per-shard drops and tenant accounting
+        are identical for quarantine and loss by construction.
+        Returns the chip's resulting state."""
+        chip = int(chip)
+        quarantined_now = False
+        with self._lock:
+            now = self.clock.monotonic()
+            score = self._decayed_locked(chip, now) + float(weight)
+            self._suspicion[chip] = [score, now]
+            st = self._state.get(chip)
+            if (score >= self._threshold()
+                    and st != STATE_QUARANTINED
+                    and _config.get("ED25519_TPU_QUARANTINE")):
+                self._state[chip] = STATE_QUARANTINED
+                self._probation_passes.pop(chip, None)
+                quarantined_now = True
+            state = self._state.get(
+                chip, STATE_SUSPECTED if score > 0 else STATE_HEALTHY)
+        if quarantined_now:
+            # Outside the lock (module contract): quarantine drops the
+            # chip's device-side residency — and only its — through
+            # the SAME listener path as a chip loss.
+            notify_chip_drop(chip, f"chip-quarantine: {reason}")
+        return state
+
+    def chip_state(self, chip: int) -> str:
+        """The chip's suspicion-ladder state (healthy / suspected /
+        quarantined / probation).  Reading applies the read-side
+        transitions (decay, quarantine → probation eligibility)."""
+        chip = int(chip)
+        with self._lock:
+            now = self.clock.monotonic()
+            self._prune_quarantine_locked(now)
+            st = self._state.get(chip)
+            if st is not None:
+                return st
+            return (STATE_SUSPECTED if self._decayed_locked(chip, now) > 0
+                    else STATE_HEALTHY)
+
+    def quarantined_chips(self) -> "frozenset[int]":
+        with self._lock:
+            self._prune_quarantine_locked(self.clock.monotonic())
+            return frozenset(c for c, st in self._state.items()
+                             if st == STATE_QUARANTINED)
+
+    def probation_chips(self) -> "frozenset[int]":
+        """Chips eligible for (or mid-) probation probing: excluded
+        from production placement, awaiting clean host-verified probe
+        chunks before rejoin (batch.run_probation_probe)."""
+        with self._lock:
+            self._prune_quarantine_locked(self.clock.monotonic())
+            dead = set(self._dead)
+            return frozenset(c for c, st in self._state.items()
+                             if st == STATE_PROBATION and c not in dead)
+
+    def excluded_chips(self) -> "frozenset[int]":
+        """Every chip production placement must avoid: reported-dead ∪
+        quarantined ∪ probation.  THE read the routing/scheduler/
+        service layers consult (round 10 widened it from dead_chips);
+        empty on a fully-healthy, fully-trusted mesh — one read, no
+        allocation beyond the frozenset."""
+        with self._lock:
+            self._prune_dead_locked()
+            self._prune_quarantine_locked(self.clock.monotonic())
+            return frozenset(self._dead) | frozenset(self._state)
+
+    def record_probation_pass(self, chip: int) -> bool:
+        """One clean (host-verified) probation probe; True when the
+        chip completed its probation and REJOINED (state and suspicion
+        cleared — the next routing read reforms back over it)."""
+        chip = int(chip)
+        with self._lock:
+            self._prune_quarantine_locked(self.clock.monotonic())
+            if self._state.get(chip) != STATE_PROBATION:
+                return False
+            n = self._probation_passes.get(chip, 0) + 1
+            if n >= self._probes_needed():
+                del self._state[chip]
+                self._probation_passes.pop(chip, None)
+                self._suspicion.pop(chip, None)
+                return True
+            self._probation_passes[chip] = n
+            return False
+
+    def record_probation_fail(self, chip: int,
+                              weight: float = SENTINEL_SUSPICION,
+                              reason: str = "probation-probe-failed"
+                              ) -> None:
+        """A probation probe diverged (or errored): straight back to
+        QUARANTINED with fresh suspicion pinned at/above the threshold
+        — the chip waits out another full decay period before its next
+        probation window, so a genuinely-corrupting chip cannot
+        oscillate its way back in."""
+        chip = int(chip)
+        with self._lock:
+            now = self.clock.monotonic()
+            score = max(self._decayed_locked(chip, now) + float(weight),
+                        self._threshold())
+            self._suspicion[chip] = [score, now]
+            requarantined = self._state.get(chip) != STATE_QUARANTINED
+            self._state[chip] = STATE_QUARANTINED
+            self._probation_passes.pop(chip, None)
+        if requarantined:
+            # The probe may have placed fresh device arrays on the
+            # chip; a failed probe distrusts them like any quarantine.
+            notify_chip_drop(chip, f"chip-requarantine: {reason}")
+
+    def chip_states(self) -> "dict[int, dict]":
+        """Observability snapshot: {chip: {state, suspicion,
+        probation_passes}} for every chip with any ledger state."""
+        with self._lock:
+            now = self.clock.monotonic()
+            self._prune_dead_locked()
+            self._prune_quarantine_locked(now)
+            chips = (set(self._dead) | set(self._state)
+                     | set(self._suspicion))
+            return {
+                c: {
+                    "state": ("dead" if c in self._dead
+                              else self._state.get(
+                                  c, STATE_SUSPECTED
+                                  if self._decayed_locked(c, now) > 0
+                                  else STATE_HEALTHY)),
+                    "suspicion": round(self._decayed_locked(c, now), 4),
+                    "probation_passes": self._probation_passes.get(c, 0),
+                }
+                for c in sorted(chips)
+            }
+
     def healthy_count(self, total: int) -> int:
-        """How many of the chips [0, total) are alive right now."""
-        dead = self.dead_chips()
-        return sum(1 for c in range(int(total)) if c not in dead)
+        """How many of the chips [0, total) are PLACEABLE right now
+        (alive, not quarantined, not on probation)."""
+        excluded = self.excluded_chips()
+        return sum(1 for c in range(int(total)) if c not in excluded)
 
     def surviving(self, want: int, total: int) -> "tuple[int, ...] | None":
-        """The first `want` healthy chip indices among [0, total), or
-        None when fewer than `want` survive.  The reformation ladder
-        places the reformed mesh on exactly these."""
-        dead = self.dead_chips()
-        out = [c for c in range(int(total)) if c not in dead]
+        """The first `want` placeable chip indices among [0, total), or
+        None when fewer than `want` remain.  The reformation ladder
+        places the reformed mesh on exactly these — quarantined and
+        probation chips are avoided exactly like dead ones."""
+        excluded = self.excluded_chips()
+        out = [c for c in range(int(total)) if c not in excluded]
         return tuple(out[:int(want)]) if len(out) >= int(want) else None
 
     def reset(self) -> None:
-        """Clear all chip-death state and restore the process clock
-        (test teardown via `reset_all`)."""
+        """Clear all chip-death, suspicion, and quarantine state and
+        restore the process clock (test teardown via `reset_all`)."""
         with self._lock:
             self._dead.clear()
+            self._suspicion.clear()
+            self._state.clear()
+            self._probation_passes.clear()
             self.clock = SYSTEM_CLOCK
 
     def __repr__(self):
         with self._lock:
-            return f"ChipRegistry(dead={sorted(self._dead)})"
+            return (f"ChipRegistry(dead={sorted(self._dead)}, "
+                    f"states={dict(sorted(self._state.items()))})")
 
 
 # The process chip registry: chip liveness is inherently process-scoped

@@ -159,6 +159,100 @@ def _compiled_sharded_kernel_many(n_devices: int, n_batches: int,
 
 
 @functools.lru_cache(maxsize=None)
+def _compiled_sharded_kernel_many_audit(n_devices: int, n_batches: int,
+                                        lanes_per_device: int, nwin: int,
+                                        wire: str = "extended",
+                                        dwire: str = "plain",
+                                        device_ids: "tuple | None" = None):
+    """The sentinel-audit twin of `_compiled_sharded_kernel_many`
+    (round 10): exactly the same sharded MSM — same shard layout, same
+    local kernel, same single all_gather collective — but the result
+    EXPOSES the per-chip partial window sums the all-reduce already
+    produces instead of discarding them after the fold:
+
+        (1 + D, B, 4, NLIMBS, nwin)
+
+    index 0 is the folded result (bit-identical to the plain kernel's
+    output — the fold runs over the same gathered tensor), indices
+    1..D are shard k's partial window sums in mesh order (shard k ↔
+    device_ids[k], or chip k on the canonical prefix mesh).  The audit
+    path host-recomputes a sampled shard's partial from the staged
+    operands and attributes any divergence to the owning chip
+    (batch.py sentinel machinery); exposing the partials is pure
+    observability — nothing downstream of the fold changes."""
+    msm_lib.ensure_compile_cache()
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..ops import jnp_edwards as E
+    import jax.numpy as jnp
+
+    mesh = mesh_lib.batch_mesh(n_devices, device_ids=device_ids)
+    axis = mesh_lib.BATCH_AXIS
+    local_kernel = msm_lib._compiled_kernel.__wrapped__(
+        lanes_per_device, nwin
+    )
+
+    def shard_fn(digits, points):
+        if dwire == "packed":
+            digits = msm_lib.expand_digits(digits)
+        if wire != "extended":
+            points = msm_lib.expand_points(points, wire)
+        part = jax.vmap(local_kernel)(digits, points)  # (B,4,NLIMBS,nwin)
+        part = jnp.transpose(part, (1, 2, 0, 3))  # (4, NLIMBS, B, nwin)
+        gathered = jax.lax.all_gather(part, axis)  # (D, 4, NLIMBS, B, nwin)
+
+        def fold(acc, p):
+            return E.point_add(acc, p), None
+
+        out, _ = jax.lax.scan(fold, E.identity_like(gathered[0]), gathered)
+        folded = jnp.transpose(out, (2, 0, 1, 3))  # (B, 4, NLIMBS, nwin)
+        partials = jnp.transpose(gathered, (0, 3, 1, 2, 4))
+        return jnp.concatenate([folded[None], partials], axis=0)
+
+    pts_spec = P(None, None, axis) if wire == "compressed" \
+        else P(None, None, None, axis)
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(P(None, None, axis), pts_spec),
+        out_specs=P(),
+    )
+    try:
+        fn = shard_map(shard_fn, check_vma=False, **kwargs)
+    except TypeError:
+        fn = shard_map(shard_fn, check_rep=False, **kwargs)
+    return jax.jit(fn)
+
+
+def sharded_window_sums_many_audit(digits, pts, n_devices: int,
+                                   clock=None, device_ids=None):
+    """Batched mesh dispatch in sentinel-AUDIT form: returns
+    (1 + D, B, 4, NLIMBS, nwin) — the folded result first, then each
+    shard's partial window sums (see the compiled builder).  Passes
+    through the SITE_SHARDED fault seam exactly like the plain mesh
+    dispatch, so per-chip corruption faults (CorruptChipSum) land on
+    the partials the audit inspects."""
+    from .. import faults as _faults
+
+    dwire = msm_lib.digit_wire_of(digits)
+    nwin = msm_lib.logical_windows(digits)
+    kernel = _compiled_sharded_kernel_many_audit(
+        n_devices, digits.shape[0], digits.shape[2] // n_devices,
+        nwin, wire=msm_lib.wire_of(pts), dwire=dwire,
+        device_ids=device_ids,
+    )
+    return _faults.run_device_call(
+        _faults.SITE_SHARDED, lambda: kernel(digits, pts),
+        mesh=n_devices, clock=clock,
+        payload=tuple(device_ids) if device_ids else None)
+
+
+@functools.lru_cache(maxsize=None)
 def _compiled_sharded_kernel_many_cached(n_devices: int, n_batches: int,
                                          n_head: int, r_per_dev: int,
                                          nwin: int,
@@ -262,7 +356,8 @@ def sharded_window_sums_many_cached(head_digits, r_digits, head, rwire,
     return _faults.run_device_call(
         _faults.SITE_SHARDED,
         lambda: kernel(head_digits, r_digits, head, rwire),
-        mesh=n_devices, clock=clock)
+        mesh=n_devices, clock=clock,
+        payload=tuple(device_ids) if device_ids else None)
 
 
 def shard_pad_cached(n_sigs: int, n_head: int, n_devices: int) -> int:
@@ -305,7 +400,8 @@ def sharded_window_sums_many(digits, pts, n_devices: int, clock=None,
     )
     return _faults.run_device_call(
         _faults.SITE_SHARDED, lambda: kernel(digits, pts),
-        mesh=n_devices, clock=clock)
+        mesh=n_devices, clock=clock,
+        payload=tuple(device_ids) if device_ids else None)
 
 
 def shard_pad(n: int, n_devices: int) -> int:

@@ -92,11 +92,14 @@ def available_devices() -> int:
 
 
 def healthy_device_count(total: "int | None" = None) -> int:
-    """The LIVE healthy device count: the configured/available device
-    count minus the chips the process ChipRegistry currently marks
-    dead.  THE input N* must be computed from — a mesh that lost k of
-    its N chips has the capacity of an (N−k)-chip mesh, whatever the
-    configured size says (the round-9 routing fix)."""
+    """The LIVE placeable device count: the configured/available device
+    count minus the chips the process ChipRegistry currently EXCLUDES —
+    reported-dead (round 9) plus quarantined/probation (round 10: a
+    chip the suspicion ledger has diagnosed as corrupting is every bit
+    as unusable as a dead one, and prices identically).  THE input N*
+    must be computed from — a mesh that lost k of its N chips has the
+    capacity of an (N−k)-chip mesh, whatever the configured size
+    says."""
     d = available_devices() if total is None else int(total)
     if d <= 0:
         return 0
@@ -116,7 +119,10 @@ def reform_for(width: "int | None" = None
     canonical prefix mesh — same executable, no re-compile).  With a
     fully-healthy mesh this is the identity: ``reform_for(D) == (D,
     None)`` for any power-of-two D ≤ the device count, so nothing
-    changes until a chip is actually marked dead."""
+    changes until a chip is actually marked dead — or, round 10,
+    QUARANTINED by the suspicion ledger: surviving placement avoids
+    quarantined/probation chips exactly like dead ones (the registry's
+    `surviving`/`healthy_count` read the excluded set)."""
     d = available_devices() if width is None else int(width)
     if d <= 0:
         return 0, None

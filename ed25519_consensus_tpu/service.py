@@ -412,7 +412,11 @@ class VerifyService:
         count — is what the dispatch actually shards over."""
         if self.mesh is not None and _health.normalize_mesh(self.mesh) == 0:
             return self.capacity_sigs
-        if not _health.chip_registry().dead_chips():
+        # excluded = dead ∪ quarantined ∪ probation (round 10): a chip
+        # the suspicion ledger pulled from placement costs drain
+        # throughput exactly like a lost one, so the watermark shrink
+        # composes with quarantine for free.
+        if not _health.chip_registry().excluded_chips():
             return self.capacity_sigs  # common case: one empty-set read
         if not _config.get("ED25519_TPU_DEGRADED_CAPACITY"):
             return self.capacity_sigs
@@ -671,7 +675,7 @@ class VerifyService:
                 mesh_arg = self.mesh
                 if (mesh_arg is not None
                         and _health.normalize_mesh(mesh_arg) > 1
-                        and _health.chip_registry().dead_chips()):
+                        and _health.chip_registry().excluded_chips()):
                     cfg_mesh = _health.normalize_mesh(mesh_arg)
                     rung, _ids = _routing.reform_for(cfg_mesh)
                     mesh_arg = rung if rung > 1 else 0
@@ -765,9 +769,14 @@ class VerifyService:
         """Snapshot: queue depth, admission state, breaker state, the
         lifetime totals, and the per-class fairness rows."""
         with self._cv:
+            reg = _health.chip_registry()
             return {
                 "queue_sigs": self._queue_sigs,
                 "effective_capacity_sigs": self.effective_capacity_sigs(),
+                # Round 10 observability: the diagnosed chip ledger an
+                # operator reads next to the capacity shrink.
+                "quarantined_chips": sorted(reg.quarantined_chips()),
+                "probation_chips": sorted(reg.probation_chips()),
                 "queue_requests": self._queued_requests(),
                 "queue_requests_by_class": {
                     cls: len(q) for cls, q in self._queues.items()},

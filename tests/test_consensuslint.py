@@ -253,6 +253,36 @@ def test_cl006_negative_mesh_chaos_overbroad_except():
                                       src)) == ["CL006"]
 
 
+def test_cl002_negative_sentinel_soak_raw_clock():
+    """The sentinel soak is decay-critical (suspicion half-lives decide
+    the probation gate): every timestamp comes from the injected
+    FakeClock, never the wall."""
+    src = ("import time\n"
+           "def decay_tick():\n"
+           "    return time.monotonic()\n")
+    findings = lint_tool_fixture("tools/sentinel_soak.py", src)
+    assert rules_of(findings) == ["CL002"]
+
+
+def test_cl004_negative_sentinel_soak_module_global():
+    """Suspicion/attribution tallies accumulate in run-local state,
+    never at module level — an ambient ledger across seeded runs is
+    exactly what makes a replay lie about detection latency."""
+    findings = lint_tool_fixture("tools/sentinel_soak.py",
+                                 "_attributions = []\n")
+    assert rules_of(findings) == ["CL004"]
+
+
+def test_cl006_negative_sentinel_soak_overbroad_except():
+    src = ("def gate(summary):\n"
+           "    try:\n"
+           "        return summary['ok']\n"
+           "    except Exception:\n"
+           "        return False\n")
+    assert rules_of(lint_tool_fixture("tools/sentinel_soak.py",
+                                      src)) == ["CL006"]
+
+
 def test_real_tenancy_and_traffic_lab_lint_clean():
     """The shipped modules themselves hold the contract they are now
     scoped under."""
@@ -262,6 +292,7 @@ def test_real_tenancy_and_traffic_lab_lint_clean():
         os.path.join(linter.PACKAGE_ROOT, "tenancy.py"),
         os.path.join(linter.REPO_ROOT, "tools", "traffic_lab.py"),
         os.path.join(linter.REPO_ROOT, "tools", "mesh_chaos.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "sentinel_soak.py"),
     ]
     findings = linter.lint_paths(paths)
     assert findings == [], [str(f) for f in findings]
@@ -562,7 +593,7 @@ def test_waiver_count_is_pinned():
     new waivers.toml entry and say why in the entry's reason).  Soak
     tooling asserts the same number off the consensuslint_waivers gauge
     (tools/load_soak.py)."""
-    assert len(linter.load_waivers()) == 5
+    assert len(linter.load_waivers()) == 6
 
 
 def test_publish_gauges_mirrors_stats():
@@ -570,7 +601,7 @@ def test_publish_gauges_mirrors_stats():
 
     st = linter.publish_gauges()
     g = metrics.gauges()
-    assert g["consensuslint_waivers"] == st["waiver_count"] == 5
+    assert g["consensuslint_waivers"] == st["waiver_count"] == 6
     assert g["consensuslint_findings_active"] == 0
     assert g["jaxpr_manifest_hash"] == st["manifest_hash"]
 
@@ -645,13 +676,14 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 25 knobs (23 through the
-    round-8 kernel work + the two round-9 degraded-mesh knobs: the
-    effective-capacity opt-out and the mesh-chaos seed)."""
+    these rows) and the registry knows all 31 knobs (25 through the
+    round-9 degraded-mesh work + the six round-10 self-diagnosing-mesh
+    knobs: sentinel rate, suspicion threshold/half-life, probation
+    length, quarantine opt-out, and the sentinel-soak seed)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 25
+    assert len(rows) == len(config.KNOBS) == 31
     assert all(doc for (_, _, _, doc) in rows)
     for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
                  "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
@@ -661,7 +693,13 @@ def test_config_registry_covers_readme_table():
                  "ED25519_TPU_DEVCACHE_TABLES_HOT_SCALE",
                  "ED25519_TPU_MIN_LANES",
                  "ED25519_TPU_DEGRADED_CAPACITY",
-                 "ED25519_TPU_MESH_CHAOS_SEED"):
+                 "ED25519_TPU_MESH_CHAOS_SEED",
+                 "ED25519_TPU_SENTINEL_RATE",
+                 "ED25519_TPU_SUSPICION_THRESHOLD",
+                 "ED25519_TPU_SUSPICION_HALF_LIFE",
+                 "ED25519_TPU_PROBATION_PROBES",
+                 "ED25519_TPU_QUARANTINE",
+                 "ED25519_TPU_SENTINEL_SOAK_SEED"):
         assert name in config.KNOBS
 
 

@@ -259,6 +259,57 @@ def test_all_invalid_stream_does_not_bench_device(monkeypatch):
     assert h.unresolved_probe_streak == 0
 
 
+def test_crafted_reject_accept_flip_is_caught_by_sentinel(monkeypatch):
+    """The false-accept hole, closed (round 10): a crafted corrupt-sum
+    fault overwrites the sharded result with identity window sums, so
+    a should-REJECT wave comes back as a device ACCEPT.  Host
+    confirmation of device REJECTS structurally cannot see this
+    direction (an accept is never re-decided) — the CONTROL half pins
+    that the hole is real.  With the sentinel audit armed, the audited
+    chunk's partials fail host recomputation, the whole chunk is
+    distrusted and host-re-decided BEFORE any verdict publishes, and
+    the bad batches are rejected."""
+    from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+    # generous EMA prior: the CPU-backend mesh kernel's first compile
+    # must not trip the (real-clock) deadline and turn this into a
+    # stall test (the CorruptSum-suite idiom)
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    staged = make_verifiers(1)[0]._stage(rng)
+    pad = shard_pad(staged.n_device_terms, 2)
+    msm.mark_shape_completed(2, pad, 2)
+    msm.mark_shape_completed(2, pad, 2, cached=3)  # the audit variant
+    vs = make_verifiers(2, bad={0, 1})
+    hv = host_verdicts(vs)
+    assert hv == [False, False]
+
+    # CONTROL (sentinel off): the device accept is trusted — the flip
+    # becomes a published false accept.  This is the documented
+    # fault-model boundary the sentinel exists to close.
+    plan = faults.sentinel_plan(0xF1, "flip-accept", chip=0,
+                                on=lambda i: True)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never",
+                                     mesh=2, sentinel_rate=0.0)
+    assert verdicts == [True, True]  # the hole, witnessed
+    batch.reset_device_health()
+
+    # SENTINEL ON: the audit catches the flip before the verdict —
+    # verdicts bit-identical to the host oracle again.
+    vs = make_verifiers(2, bad={0, 1})
+    plan = faults.sentinel_plan(0xF2, "flip-accept", chip=0,
+                                on=lambda i: True)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never",
+                                     mesh=2, sentinel_rate=1.0)
+    assert verdicts == hv == [False, False]
+    stats = batch.last_run_stats
+    assert stats["sentinel"]["divergence"] >= 1
+    assert stats["device_batches"] == 0  # nothing trusted from the flip
+
+
 # -- fault class: mid-flight lane death -----------------------------------
 
 

@@ -1,0 +1,535 @@
+"""The self-diagnosing mesh (round 10): typed error classification,
+sentinel audits with per-chip attribution, and the quarantine →
+probation → rejoin ladder.
+
+Three layers under test:
+
+* **Classifier** (health.classify_device_error): every typed exception
+  lands in its INTENDED {transient, fatal, ambiguous} branch — pinned
+  per type — and the scheduler applies the intended outcome (retry /
+  mark-dead / suspicion).  The acceptance bar: no classification
+  outcome is ever derived from a generic catch-all — an unrecognized
+  exception can only land in the designated AMBIGUOUS bucket.
+* **Sentinel audits** (batch._sentinel_check + the audit-form sharded
+  dispatch): a sampled shard's partial sum is host-recomputed from the
+  staged operand bytes; a chip that silently corrupts its partial is
+  detected AND attributed, and a distrusted chunk is host-re-decided
+  before any verdict publishes — verdicts bit-identical to the host
+  oracle throughout.
+* **Quarantine ladder** (health.ChipRegistry): suspicion accumulates
+  and decays; crossing the threshold quarantines (firing the same
+  chip-drop listeners as a loss); decay relaxes quarantine to
+  probation; clean host-verified probes (batch.run_probation_probe)
+  rejoin; a diverging probe re-quarantines.
+
+Timing runs on health.FakeClock throughout — no wall-time bounds.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import SigningKey, batch, faults, health
+from ed25519_consensus_tpu.ops import msm
+
+jax = pytest.importorskip("jax")
+
+rng = random.Random(0x5E471E1)
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    monkeypatch.setenv("ED25519_TPU_EMA_PRIOR", "10")
+    yield
+    faults.uninstall()
+    batch._DeviceLane.reset_all()
+    batch.reset_device_health()  # clears the chip ledger too
+    batch.last_run_stats.clear()
+
+
+def _require_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"need {n} devices, have {len(jax.devices())}")
+
+
+def make_verifiers(n_batches, sigs_per_batch=3, bad=()):
+    out = []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        for i in range(sigs_per_batch):
+            sk = SigningKey.new(rng)
+            msg = b"sentinel-%d-%d" % (b, i)
+            sig = sk.sign(msg if (b not in bad or i != 0)
+                          else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        out.append(v)
+    return out
+
+
+def host_verdicts(vs):
+    return [batch._host_verdict(v, rng) for v in vs]
+
+
+def mark_shapes_warm(chunk=2, mesh=0, sigs_per_batch=3, audit=False):
+    staged = make_verifiers(1, sigs_per_batch=sigs_per_batch)[0]._stage(
+        rng)
+    if mesh and mesh > 1:
+        from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+        pad = shard_pad(staged.n_device_terms, mesh)
+    else:
+        pad = msm.preferred_pad(staged.n_device_terms)
+    msm.mark_shape_completed(chunk, pad, mesh)
+    if audit:
+        msm.mark_shape_completed(chunk, pad, mesh, cached=3)
+    return pad
+
+
+# -- classifier: every typed exception lands in its intended branch --------
+
+
+def test_classifier_rule_table_is_typed_not_catch_all():
+    """Each input shape maps to exactly its declared branch; anything
+    unrecognized — including a LYING marker — can only land in the
+    designated AMBIGUOUS bucket."""
+    c = health.classify_device_error
+    assert c(faults.TransientDispatchError("x")).cls == \
+        health.ERROR_TRANSIENT
+    ev = c(faults.FatalChipError("x", chips=(3, 5), heal_after=7.0,
+                                 chips_marked=True))
+    assert ev.cls == health.ERROR_FATAL
+    assert ev.chips == (3, 5) and ev.marked and ev.heal_after == 7.0
+    assert c(TimeoutError("t")).cls == health.ERROR_TRANSIENT
+    assert c(ConnectionResetError("r")).cls == health.ERROR_TRANSIENT
+    assert c(OSError("o")).cls == health.ERROR_TRANSIENT
+    # the designated unknown bucket — never transient, never fatal
+    assert c(faults.InjectedFault("i")).cls == health.ERROR_AMBIGUOUS
+    assert c(ValueError("v")).cls == health.ERROR_AMBIGUOUS
+    assert c(None).cls == health.ERROR_AMBIGUOUS
+
+    class Liar(RuntimeError):
+        device_error_class = "retry-me-forever"  # not a valid class
+
+    assert c(Liar("l")).cls == health.ERROR_AMBIGUOUS
+
+
+def test_transient_error_is_retried_and_decided_on_device():
+    """transient → retry with bounded backoff: one injected transient
+    error on the first call, the retry dispatches clean, and the
+    batches are DECIDED ON THE DEVICE (not benched to the host) —
+    verdicts identical to the pure-host path."""
+    mark_shapes_warm()
+    vs = make_verifiers(4, bad={1})
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(1, "transient", at=0, length=1)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never")
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["error_classes"][health.ERROR_TRANSIENT] == 1
+    assert stats["transient_retries"] == 1
+    assert stats["device_batches"] >= 1  # the retry really dispatched
+    assert not stats["device_sick"]
+    # no suspicion, no dead chips — transient means transient
+    reg = health.chip_registry()
+    assert reg.excluded_chips() == frozenset()
+    assert reg.suspicion(0) == 0.0
+
+
+def test_transient_retry_budget_is_bounded():
+    """A PERSISTENT 'transient' error exhausts the bounded retry
+    budget and falls to the ordinary host ladder — no livelock, all
+    verdicts host-identical."""
+    mark_shapes_warm()
+    vs = make_verifiers(4, bad={0})
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(2, "transient", at=0, length=64)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never")
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["transient_retries"] == 2  # the per-call budget
+    assert stats["host_batches"] == 4
+    assert stats["device_batches"] == 0
+
+
+def test_fatal_error_marks_named_chips_dead():
+    """fatal → the intended outcome is the named chips DEAD in the
+    ChipRegistry (no retry, no suspicion) — pinned on the cheap
+    single-device lane; the full mesh-reform consequence is the slow
+    variant below (and tools/sentinel_soak.py in the faults CI job)."""
+    mark_shapes_warm()
+    vs = make_verifiers(4, bad={2})
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(3, "fatal", at=0, length=1,
+                                   chips=(1,))
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never")
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["error_classes"][health.ERROR_FATAL] == 1
+    assert stats["transient_retries"] == 0
+    reg = health.chip_registry()
+    assert reg.dead_chips() == frozenset({1})
+    assert reg.suspicion(0) == 0.0  # fatal never smears suspicion
+
+
+@pytest.mark.slow
+def test_fatal_error_marks_named_chips_dead_and_reforms():
+    """fatal → the named chips are marked dead in the ChipRegistry and
+    the existing reformation ladder reforms the wave around them."""
+    _require_devices(2)
+    mark_shapes_warm(mesh=2)
+    vs = make_verifiers(4, bad={2})
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(3, "fatal", at=0, length=1,
+                                   chips=(1,),
+                                   site=faults.SITE_SHARDED)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never",
+                                     mesh=2)
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["error_classes"][health.ERROR_FATAL] == 1
+    assert health.chip_registry().dead_chips() == frozenset({1})
+    assert len(stats["mesh_reformations"]) >= 1
+    assert stats["mesh_reformations"][-1]["device_ids"] == [0, 2]
+
+
+def test_ambiguous_error_records_placement_suspicion_only():
+    """ambiguous → suspicion smeared over the placement, nothing else:
+    no retry, no chip death, the classic host fallback decides — and
+    one error is nowhere near the quarantine threshold."""
+    mark_shapes_warm()
+    vs = make_verifiers(4)
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(4, "ambiguous", at=0, length=64)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never")
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["error_classes"][health.ERROR_AMBIGUOUS] >= 1
+    assert stats["transient_retries"] == 0
+    reg = health.chip_registry()
+    assert reg.dead_chips() == frozenset()
+    assert 0 < reg.suspicion(0) < 3.0  # suspected, not quarantined
+    assert reg.chip_state(0) == health.STATE_SUSPECTED
+    assert reg.excluded_chips() == frozenset()
+
+
+def test_stdlib_timeout_takes_the_transient_branch(monkeypatch):
+    """The non-marker classifier rows (structural stdlib types) reach
+    the same retry outcome as the typed marker."""
+    mark_shapes_warm()
+    vs = make_verifiers(2)
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(5, "timeout", at=0, length=1)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never")
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["error_classes"][health.ERROR_TRANSIENT] == 1
+    assert stats["transient_retries"] == 1
+
+
+# -- the quarantine → probation → rejoin ladder (registry units) -----------
+
+
+def test_suspicion_accumulates_decays_and_quarantines():
+    clk = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clk)
+    drops = []
+    health.register_chip_drop_listener(
+        lambda chip, reason, _d=drops: _d.append((chip, reason)))
+    assert reg.record_suspicion(5, 1.5, "audit-1") == \
+        health.STATE_SUSPECTED
+    # decay: half-life 300 s halves the score
+    clk.advance(300.0)
+    assert reg.suspicion(5) == pytest.approx(0.75)
+    # fresh evidence stacks on the decayed score and crosses threshold
+    reg.record_suspicion(5, 1.5, "audit-2")
+    st = reg.record_suspicion(5, 1.5, "audit-3")
+    assert st == health.STATE_QUARANTINED
+    assert 5 in reg.excluded_chips()
+    assert reg.dead_chips() == frozenset()  # liveness is separate
+    # the SAME listener path as a chip loss fired, with the reason
+    assert any(c == 5 and "quarantine" in r for c, r in drops)
+
+
+def test_quarantine_relaxes_to_probation_then_rejoins():
+    clk = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clk)
+    reg.record_suspicion(2, 3.0, "storm")
+    assert reg.chip_state(2) == health.STATE_QUARANTINED
+    # decay below half the threshold → probation eligibility (a read)
+    clk.advance(900.0)  # 3 half-lives: 3.0 → 0.375 < 1.5
+    assert reg.chip_state(2) == health.STATE_PROBATION
+    assert 2 in reg.excluded_chips()  # probation is still OUT
+    # the configured streak of clean probes rejoins
+    assert not reg.record_probation_pass(2)
+    assert not reg.record_probation_pass(2)
+    assert reg.record_probation_pass(2)
+    assert reg.chip_state(2) == health.STATE_HEALTHY
+    assert reg.excluded_chips() == frozenset()
+    assert reg.suspicion(2) == 0.0
+
+
+def test_probation_fail_requarantines_with_fresh_suspicion():
+    clk = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clk)
+    reg.record_suspicion(4, 3.0, "storm")
+    clk.advance(900.0)
+    assert reg.chip_state(4) == health.STATE_PROBATION
+    assert not reg.record_probation_pass(4)  # one clean probe...
+    reg.record_probation_fail(4)             # ...then a divergence
+    assert reg.chip_state(4) == health.STATE_QUARANTINED
+    assert reg.suspicion(4) >= 3.0  # pinned back at/above threshold
+    # the pass streak reset: after the next probation window it takes
+    # the FULL streak again
+    clk.advance(1200.0)
+    assert reg.chip_state(4) == health.STATE_PROBATION
+    assert not reg.record_probation_pass(4)
+
+
+def test_quarantine_optout_keeps_ledger_report_only(monkeypatch):
+    monkeypatch.setenv("ED25519_TPU_QUARANTINE", "0")
+    reg = health.chip_registry()
+    reg.set_clock(health.FakeClock())
+    st = reg.record_suspicion(1, 99.0, "huge")
+    assert st == health.STATE_SUSPECTED  # never quarantined
+    assert reg.excluded_chips() == frozenset()
+    assert reg.suspicion(1) == pytest.approx(99.0)
+
+
+def test_quarantine_reforms_routing_like_chip_loss():
+    """routing.reform_for avoids quarantined chips exactly like dead
+    ones, and verify_many's entry clamp reforms placement around
+    them."""
+    from ed25519_consensus_tpu import routing
+
+    _require_devices(4)
+    reg = health.chip_registry()
+    reg.set_clock(health.FakeClock())
+    assert routing.reform_for(4) == (4, None)
+    reg.record_suspicion(1, 3.0, "storm")
+    rung, ids = routing.reform_for(4)
+    # The substitution universe is ALL addressable chips (the PR 8
+    # rule): the rung holds its width on the surviving subset.
+    assert rung == 4 and ids is not None and 1 not in ids
+    assert routing.healthy_device_count(4) == 3
+
+
+# -- sentinel audits --------------------------------------------------------
+
+
+def test_sentinel_clean_mesh_audits_pass_and_device_decides():
+    """Audit rate 1.0 on an honest mesh: every chunk audited, zero
+    divergence, verdicts identical, the device keeps its wins."""
+    _require_devices(2)
+    mark_shapes_warm(mesh=2, audit=True)
+    vs = make_verifiers(4, bad={2})
+    hv = host_verdicts(vs)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never", mesh=2,
+                                 sentinel_rate=1.0)
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    sen = stats["sentinel"]
+    assert sen["audits"] >= 1 and sen["divergence"] == 0
+    assert stats["device_batches"] >= 1
+    assert health.chip_registry().excluded_chips() == frozenset()
+
+
+def test_sentinel_attributes_corrupt_chip_and_protects_verdicts():
+    """One chip silently corrupts its partial sum: the audit
+    host-recomputes the shard, attributes the divergence to exactly
+    that chip, suspicion lands, and every distrusted chunk is
+    host-re-decided — verdicts bit-identical to the pure-host path."""
+    _require_devices(2)
+    mark_shapes_warm(mesh=2, audit=True)
+    vs = make_verifiers(4, bad={0})
+    hv = host_verdicts(vs)
+    plan = faults.sentinel_plan(7, "corrupt-chip", chip=1,
+                                on=lambda i: True)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never",
+                                     mesh=2, sentinel_rate=1.0)
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    sen = stats["sentinel"]
+    assert sen["divergence"] >= 1
+    assert set(sen["attributed"]) == {1}  # exact attribution
+    assert stats["device_batches"] == 0  # distrusted chunks host-decided
+    assert health.chip_registry().suspicion(1) > 0
+    assert health.chip_registry().suspicion(0) == 0.0
+
+
+def test_sentinel_rate_zero_never_audits():
+    _require_devices(2)
+    mark_shapes_warm(mesh=2)
+    vs = make_verifiers(2)
+    hv = host_verdicts(vs)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never", mesh=2,
+                                 sentinel_rate=0.0)
+    assert verdicts == hv
+    assert batch.last_run_stats["sentinel"]["audits"] == 0
+
+
+def test_sentinel_sampling_is_deterministic():
+    """The audit draw is a pure function of the dispatch ordinal — two
+    runs at the same fractional rate audit identical ordinals."""
+    fires = [batch._sentinel_fires(0.5, i) for i in range(64)]
+    assert fires == [batch._sentinel_fires(0.5, i) for i in range(64)]
+    assert any(fires) and not all(fires)
+    assert all(batch._sentinel_fires(1.0, i) for i in range(4))
+    assert not any(batch._sentinel_fires(0.0, i) for i in range(4))
+
+
+@pytest.mark.slow
+def test_persistent_corruptor_is_quarantined_within_bounded_waves():
+    """The soak property at test scale: a persistently-corrupting chip
+    accumulates sentinel suspicion and is QUARANTINED within
+    ceil(threshold / sentinel-weight) audited chunks; the next call
+    reforms placement around it and decides on the device again."""
+    _require_devices(2)
+    mark_shapes_warm(mesh=2, audit=True)
+    reg = health.chip_registry()
+    reg.set_clock(health.FakeClock())  # no decay between audits
+    plan = faults.sentinel_plan(8, "corrupt-chip", chip=1,
+                                on=lambda i: True)
+    hv_all, got_all = [], []
+    with faults.injected(plan):
+        for wave in range(2):  # ceil(3.0 / 1.5) = 2 audited chunks
+            vs = make_verifiers(2, bad={wave})
+            hv_all.extend(host_verdicts(vs))
+            got_all.extend(batch.verify_many(
+                vs, rng=rng, chunk=2, hybrid=False, merge="never",
+                mesh=2, sentinel_rate=1.0))
+            if reg.chip_state(1) == health.STATE_QUARANTINED:
+                break
+    assert got_all == hv_all
+    assert reg.chip_state(1) == health.STATE_QUARANTINED
+    # the corruptor is out of the collective: the next call reforms
+    # placement onto survivors (the substitution universe is all
+    # addressable chips, so the rung keeps its width) and — with the
+    # fault plan gone — audits come back clean
+    vs = make_verifiers(2)
+    hv = host_verdicts(vs)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never", mesh=2,
+                                 sentinel_rate=1.0)
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["device_ids"] is not None
+    assert 1 not in stats["device_ids"]
+    assert stats["sentinel"]["divergence"] == 0
+
+
+def test_transient_retry_redispatches_in_hybrid_mode():
+    """Review regression: in hybrid mode the probe gate must re-arm
+    after a transient retry — without it the 'retry' silently drains
+    host-side while transient_retries reports a dispatch that never
+    happened.  The retried probe reaches the device-call seam again
+    (the plan sees a second lane call)."""
+    mark_shapes_warm()
+    vs = make_verifiers(2)
+    hv = host_verdicts(vs)
+    plan = faults.typed_error_plan(9, "transient", at=0, length=1)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=True, merge="never")
+    stats = batch.last_run_stats
+    assert verdicts == hv
+    assert stats["transient_retries"] == 1
+    # the retry actually re-dispatched: a second call crossed the seam
+    assert plan.calls_seen(faults.SITE_LANE) >= 2
+
+
+def test_sampled_audit_quarantine_reforms_rest_of_call(monkeypatch):
+    """Review regression (the sampled-rate hole): when an audited
+    chunk's divergence QUARANTINES a chip of the current placement,
+    the rest of the call must not keep dispatching on the diagnosed
+    mesh — later UNAUDITED chunks would republish exactly the
+    corruption the audit caught.  One audited chunk (ordinal 0 only),
+    a flip-accept corruptor, all-bad batches: the unaudited second
+    chunk must re-issue on a reformed placement that excludes the
+    corruptor, and every verdict stays False."""
+    _require_devices(3)
+    monkeypatch.setenv("ED25519_TPU_SUSPICION_THRESHOLD", "1.5")
+    # deterministic sampling stand-in: audit exactly the first chunk
+    monkeypatch.setattr(batch, "_sentinel_fires",
+                        lambda rate, i: i == 0)
+    mark_shapes_warm(mesh=2, audit=True)
+    vs = make_verifiers(4, bad={0, 1, 2, 3})
+    hv = host_verdicts(vs)
+    assert hv == [False] * 4
+    plan = faults.sentinel_plan(10, "flip-accept", chip=1,
+                                on=lambda i: True)
+    with faults.injected(plan):
+        verdicts = batch.verify_many(vs, rng=rng, chunk=2,
+                                     hybrid=False, merge="never",
+                                     mesh=2, sentinel_rate=0.5)
+    stats = batch.last_run_stats
+    assert verdicts == hv == [False] * 4  # no false accept republished
+    assert stats["sentinel"]["divergence"] == 1
+    reg = health.chip_registry()
+    assert reg.chip_state(1) == health.STATE_QUARANTINED
+    # the rest of the call reformed onto survivors (chip 1 excluded)
+    assert stats["mesh_reformations"]
+    assert 1 not in (stats["device_ids"] or [])
+
+
+def test_probation_probe_end_to_end_rejoins_clean_chip():
+    """batch.run_probation_probe: host-verified probe chunks on the
+    (virtual) device — clean sums pass, the configured streak rejoins
+    the chip."""
+    import ed25519_consensus_tpu.config as config
+
+    clk = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clk)
+    reg.record_suspicion(1, 3.0, "storm")
+    clk.advance(900.0)
+    assert reg.chip_state(1) == health.STATE_PROBATION
+    for _ in range(config.get("ED25519_TPU_PROBATION_PROBES")):
+        assert batch.run_probation_probe(
+            make_verifiers(1)[0], 1, rng=rng) is True
+    assert reg.chip_state(1) == health.STATE_HEALTHY
+    assert reg.excluded_chips() == frozenset()
+
+
+def test_probation_probe_divergence_requarantines(monkeypatch):
+    """A probe whose device sum diverges from the exact host MSM is a
+    FAIL: straight back to quarantine — a genuinely-corrupting chip
+    cannot rejoin through probation."""
+    clk = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clk)
+    reg.record_suspicion(1, 3.0, "storm")
+    clk.advance(900.0)
+    assert reg.chip_state(1) == health.STATE_PROBATION
+
+    real = msm.dispatch_window_sums_many
+
+    def corrupted(digits, pts):
+        out = np.array(real(digits, pts), copy=True)
+        out[..., 0] += 1  # the corrupting-chip model, probe-sized
+        return out
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", corrupted)
+    assert batch.run_probation_probe(
+        make_verifiers(1)[0], 1, rng=rng) is False
+    assert reg.chip_state(1) == health.STATE_QUARANTINED
+    assert reg.suspicion(1) >= 3.0

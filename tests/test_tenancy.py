@@ -510,3 +510,45 @@ def test_chip_loss_drops_only_dead_shard_residency(reset_state):
     h = health.DeviceHealth(clock=health.FakeClock())
     h.mark_lane_stuck()
     assert cache.resident_count() == 0
+
+
+def test_chip_quarantine_drops_shard_residency_like_chip_loss(
+        reset_state):
+    """Round 10 (extends the round-9 chip-loss pin to the quarantine
+    trigger): a chip QUARANTINED by the suspicion ledger fires the
+    SAME chip-drop listener path as a reported loss — only device
+    arrays whose placement covers the quarantined chip drop, every
+    tenant's entries stay resident, tenant partitions on surviving
+    chips keep hit rate 1.0, and the devcache tallies the drop in both
+    chip_drops and the quarantine_drops sub-counter."""
+    cache = reset_state
+    health.chip_registry().set_clock(health.FakeClock())
+    head = np.zeros((4, 20, 4), dtype=np.int16)
+    entries = {}
+    for name, tag in ((b"a", "A"), (b"b", "B")):
+        d = devcache.keyset_digest(name * 32)
+        cache.assign_tenant(d, tag)
+        cache.should_build(d)
+        cache.build(d, 1, head)
+        entries[tag] = (d, cache.lookup(d))
+    assert cache.resident_count() == 2
+    for _d, e in entries.values():
+        e.device_ref(0)   # single-lane placement (chip 0)
+        e.device_ref(8)   # full-mesh placement (chips 0..7)
+    # chip 5 crosses the suspicion threshold: quarantine → the same
+    # per-shard drop as a loss, never a partition wipe
+    st = health.chip_registry().record_suspicion(
+        5, 3.0, "sentinel-audit divergence")
+    assert st == health.STATE_QUARANTINED
+    assert cache.resident_count() == 2
+    for tag in ("A", "B"):
+        d, e = entries[tag]
+        assert set(e._device_refs) == {(0, None)}
+        assert cache.lookup(d) is not None
+    ts = cache.tenant_stats()
+    for tag in ("A", "B"):
+        assert ts[tag]["hit_rate"] == 1.0
+        assert ts[tag]["resident_keysets"] == 1
+        assert ts[tag]["evictions"] == 0
+    assert cache.counters["chip_drops"] == 2
+    assert cache.counters["quarantine_drops"] == 2

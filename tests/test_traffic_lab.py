@@ -130,6 +130,29 @@ def test_percentiles_nearest_rank():
                                           0.999: 7.0}
 
 
+def test_load_sweep_emits_invariant_gated_curve():
+    """--load-sweep (ROADMAP item 3 follow-up): the latency-vs-load
+    curve is monotone in the right direction — shed pressure grows
+    with offered load — and the invariant gates (zero lost,
+    host-identical, consensus shed 0) hold at EVERY point, including
+    above capacity."""
+    assert lab.parse_load_sweep("0.5:1.2:8") == [
+        0.5, 0.6, 0.7, 0.8, 0.9, 1.0, 1.1, 1.2]
+    assert lab.parse_load_sweep("0.5,1.2") == [0.5, 1.2]
+    assert lab.parse_load_sweep("") == []
+    sweep = lab.run_load_sweep(make_cfg(requests=80), [0.5, 1.2])
+    assert sweep["ok"], sweep
+    curve = sweep["curve"]
+    assert [pt["load"] for pt in curve] == [0.5, 1.2]
+    for pt in curve:
+        assert all(pt["invariants"].values()), pt
+        assert pt["shed_rate_by_class"]["consensus"] == 0.0
+    # pressure rises across the sweep: the over-capacity point sheds
+    # at least as much rpc as the half-load point
+    assert curve[1]["shed_rate_by_class"]["rpc"] >= \
+        curve[0]["shed_rate_by_class"]["rpc"]
+
+
 @pytest.mark.slow
 def test_lab_device_mode_reports_tenant_hit_rates():
     """--device on the CPU backend: waves dispatch through the device

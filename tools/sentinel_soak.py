@@ -1,0 +1,380 @@
+"""Sentinel soak: the self-diagnosing-mesh CI gate (round 10).
+
+PR 8's reformation ladder survives chips that are REPORTED dead; this
+soak proves the detection layer that produces those reports from
+evidence.  The failure class under test is the one tolerance alone
+cannot handle: a chip that silently corrupts its partial Edwards sum —
+every wave it touches fails device-side (or, worse, a crafted
+corruption flips a should-reject wave to a device ACCEPT, which host
+confirmation of rejects can never see), while the mesh looks perfectly
+healthy.  Two phases, both pure functions of the seed:
+
+**Phase A — persistent corruptor.**  One chip of the 8-mesh corrupts
+its partial on EVERY sharded call (`faults.CorruptChipSum`).  With the
+sentinel audit armed (rate 1.0), the gates are:
+
+* the corruptor is detected — audit divergence attributed to exactly
+  that chip — and QUARANTINED within K waves (K = the suspicion
+  threshold over the per-divergence weight: bounded, not eventual);
+* every verdict before, during, and after detection is bit-identical
+  to the host oracle (a distrusted chunk is host-re-decided before any
+  verdict publishes);
+* after quarantine the mesh REFORMS: the registry reports the
+  7-of-8 available fraction, dispatch runs the widest surviving rung
+  (the power-of-two ladder: 4), waves keep deciding on the device, and
+  the service's effective-capacity watermark base shrinks;
+* the crafted reject→accept flip on the reformed mesh is caught by the
+  audit before the verdict is published (the false-accept hole is
+  closed; the unaudited control in tests/test_faults.py documents the
+  hole itself).
+
+**Phase B — transient corruptor.**  A chip corrupts just long enough
+to be quarantined, then stops.  Its suspicion decays (FakeClock), the
+read side relaxes quarantine to PROBATION, `batch.run_probation_probe`
+dispatches low-stakes host-verified probe chunks on it, and after the
+configured clean streak the chip REJOINS: routing reforms back to the
+full 8-mesh and a final full-width wave verifies host-identically with
+zero reformations.  A genuinely-corrupting chip can never walk this
+path — its probes diverge and re-quarantine it (pinned in
+tests/test_sentinel.py).
+
+Usage:
+  python tools/sentinel_soak.py [--seed N] [--devices 8] [--chip 5]
+      [--json]
+
+Exit status is nonzero unless every gate holds.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu import (  # noqa: E402
+    SigningKey, batch, config, devcache, faults, health, routing, service,
+    tenancy,
+)
+
+_stable_seed = tenancy._stable_seed
+
+
+def make_wave(seed, keys, tag, n_batches=2, bad_rate=0.25):
+    """A keyset-uniform wave of verifiers plus its host-oracle truth
+    (same construction as tools/mesh_chaos.py): seeded tampering keeps
+    REAL False verdicts flowing through the detection machinery."""
+    vs, want = [], []
+    for b in range(n_batches):
+        rnd = random.Random(_stable_seed(seed, "wave", tag, b))
+        bad = rnd.random() < bad_rate
+        v = batch.Verifier()
+        for j, sk in enumerate(keys):
+            msg = b"sentinel-soak %s %d %d" % (tag.encode(), b, j)
+            sig = sk.sign(msg if not (bad and j == 0) else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        vs.append(v)
+        want.append(not bad)
+    return vs, want
+
+
+def premark_shapes(seed, keys, devices):
+    """Pre-mark every rung's padded chunk shape (audit and plain
+    variants) compile-complete, so the soak exercises the DETECTION
+    machinery rather than the compile-grace machinery — the
+    mesh_chaos.py discipline."""
+    from ed25519_consensus_tpu.ops import msm
+    from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+    probe, _ = make_wave(seed, keys, "shape-probe", n_batches=1,
+                         bad_rate=0.0)
+    n_terms = probe[0]._stage(None).n_device_terms
+    m = devices
+    while m >= 2:
+        pad = shard_pad(n_terms, m)
+        msm.mark_shape_completed(2, pad, m)
+        msm.mark_shape_completed(2, pad, m, cached=3)
+        m //= 2
+    msm.mark_shape_completed(2, msm.preferred_pad(n_terms), 0)
+
+
+def waves_to_quarantine() -> int:
+    """The bounded detection claim: ceil(threshold / sentinel-weight)
+    audited chunks cross the suspicion threshold, and each 2-batch
+    wave at chunk=2 produces exactly ONE audited chunk — so this is
+    the wave bound both phases gate on (integer-scaled ceiling: the
+    knob values are floats, the bound must not wobble on rounding)."""
+    threshold = config.get("ED25519_TPU_SUSPICION_THRESHOLD")
+    return max(1, -(-int(threshold * 1000)
+                    // int(health.SENTINEL_SUSPICION * 1000)))
+
+
+def run_wave(seed, keys, tag, hp, rng, mesh, bad_rate=0.25):
+    """One forced-device wave; returns (host_identical, stats)."""
+    vs, want = make_wave(seed, keys, tag, bad_rate=bad_rate)
+    got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                            merge="never", mesh=mesh, health=hp,
+                            sentinel_rate=1.0)
+    return got == want and len(got) == len(want), \
+        dict(batch.last_run_stats)
+
+
+def run_persistent_corruptor(seed, devices=8, chip=5) -> dict:
+    """Phase A (see module docstring)."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=devices, clock=clock)
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    # Cold-path dispatches only: the sentinel audits the cold sharded
+    # wire by design (the cached forms keep operands off the wire and
+    # are covered by hash re-checks + host confirmation instead).
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(enabled=False))
+    rnd = random.Random(_stable_seed(seed, "keys"))
+    keys = [SigningKey.new(rnd) for _ in range(4)]
+    rng = random.Random(_stable_seed(seed, "rng"))
+    premark_shapes(seed, keys, devices)
+
+    k_waves = waves_to_quarantine()
+    results = {"ok": True, "chip": chip, "k_wave_bound": k_waves,
+               "waves": []}
+    try:
+        plan = faults.sentinel_plan(seed, "corrupt-chip", chip=chip,
+                                    on=lambda i: True)
+        detected_at = None
+        with faults.injected(plan):
+            for w in range(k_waves):
+                identical, st = run_wave(seed, keys, "storm-%d" % w,
+                                         hp, rng, devices)
+                results["waves"].append({
+                    "wave": w, "host_identical": identical,
+                    "sentinel": st["sentinel"],
+                    "mesh": st.get("mesh"),
+                })
+                results["ok"] = results["ok"] and identical
+                if reg.chip_state(chip) == health.STATE_QUARANTINED:
+                    detected_at = w
+                    break
+        results["detected_at_wave"] = detected_at
+        results["quarantined_within_bound"] = detected_at is not None
+        results["attributions"] = [
+            c for wv in results["waves"]
+            for c in wv["sentinel"]["attributed"]]
+        results["attribution_exact"] = (
+            set(results["attributions"]) == {chip}
+            and len(results["attributions"]) > 0)
+        results["ok"] = (results["ok"]
+                         and results["quarantined_within_bound"]
+                         and results["attribution_exact"])
+
+        # The corruptor is OUT of the collective now — the mesh reforms
+        # to the widest surviving rung and keeps deciding on-device.
+        avail = routing.healthy_device_count(devices)
+        rung, ids = routing.reform_for(devices)
+        identical, st = run_wave(seed, keys, "reformed", hp, rng,
+                                 devices)
+        participated = (st.get("device_batches", 0)
+                        + st.get("device_rejects_confirmed", 0)
+                        + st.get("device_rejects_overturned", 0))
+        results["reformed"] = {
+            "available_chips": avail,
+            "available_fraction": avail / devices,
+            "reformed_rung": rung,
+            "device_ids": list(ids) if ids else None,
+            "mesh_after": st.get("mesh"),
+            "host_identical": identical,
+            "device_participated": participated,
+            "sentinel_divergence": st["sentinel"]["divergence"],
+            "ok": (identical and avail == devices - 1
+                   and rung == devices // 2
+                   and st.get("mesh") == devices // 2
+                   and participated >= 1
+                   and st["sentinel"]["divergence"] == 0),
+        }
+        results["ok"] = results["ok"] and results["reformed"]["ok"]
+
+        # Service compose: the degraded-capacity watermark base shrinks
+        # for a quarantined chip exactly as for a lost one.
+        svc = service.VerifyService(capacity_sigs=8000, mesh=None,
+                                    clock=clock, auto_start=False)
+        st_svc = svc.stats()
+        svc.close()
+        results["service"] = {
+            "capacity_sigs": 8000,
+            "effective_capacity_sigs":
+                st_svc["effective_capacity_sigs"],
+            "quarantined_chips": st_svc["quarantined_chips"],
+            "ok": (st_svc["effective_capacity_sigs"] < 8000
+                   and st_svc["quarantined_chips"] == [chip]),
+        }
+        results["ok"] = results["ok"] and results["service"]["ok"]
+
+        # The crafted reject→accept flip on the REFORMED mesh: every
+        # batch bad, the fault forces identity window sums (device
+        # ACCEPT).  The audit must catch it before any verdict
+        # publishes — the gate is simply "the verdicts are still the
+        # host's".
+        flip_chip = 0
+        plan = faults.sentinel_plan(seed, "flip-accept", chip=flip_chip,
+                                    on=lambda i: True)
+        with faults.injected(plan):
+            vs, want = make_wave(seed, keys, "flip", bad_rate=1.0)
+            got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                    merge="never", mesh=devices,
+                                    health=hp, sentinel_rate=1.0)
+        st = dict(batch.last_run_stats)
+        results["flip_accept"] = {
+            "want": want, "got": got,
+            "sentinel_divergence": st["sentinel"]["divergence"],
+            "ok": got == want and st["sentinel"]["divergence"] >= 1,
+        }
+        results["ok"] = results["ok"] and results["flip_accept"]["ok"]
+    finally:
+        devcache.set_default_cache(None)
+        batch.reset_device_health()  # chip registry + ledger reset
+    return results
+
+
+def run_transient_corruptor(seed, devices=8, chip=3) -> dict:
+    """Phase B (see module docstring)."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=devices, clock=clock)
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(enabled=False))
+    rnd = random.Random(_stable_seed(seed, "keys"))
+    keys = [SigningKey.new(rnd) for _ in range(4)]
+    rng = random.Random(_stable_seed(seed, "rng2"))
+    premark_shapes(seed, keys, devices)
+
+    results = {"ok": True, "chip": chip}
+    try:
+        # Corrupt until quarantined (bounded like phase A), then STOP —
+        # the transient-corruptor model (bad HBM page remapped, link
+        # reseated, thermal event passed).
+        k_waves = waves_to_quarantine()
+        plan = faults.sentinel_plan(seed, "corrupt-chip", chip=chip,
+                                    on=lambda i: True)
+        identical = True
+        with faults.injected(plan):
+            for w in range(k_waves):
+                ok_w, st = run_wave(seed, keys,
+                                    "transient-storm-%d" % w,
+                                    hp, rng, devices)
+                identical = identical and ok_w
+                if reg.chip_state(chip) == health.STATE_QUARANTINED:
+                    break
+        results["storm_host_identical"] = identical
+        results["quarantined"] = (
+            reg.chip_state(chip) == health.STATE_QUARANTINED)
+        results["ok"] = (results["ok"] and identical
+                         and results["quarantined"])
+
+        # Suspicion decays on the registry clock; the read side
+        # relaxes quarantine to probation eligibility.
+        half_life = config.get("ED25519_TPU_SUSPICION_HALF_LIFE")
+        clock.advance(6 * half_life)
+        results["probation_eligible"] = (
+            reg.chip_state(chip) == health.STATE_PROBATION)
+        results["ok"] = results["ok"] and results["probation_eligible"]
+
+        # Clean probation: low-stakes host-verified probe chunks on the
+        # probation chip until the configured streak rejoins it.
+        probes = []
+        for p in range(config.get("ED25519_TPU_PROBATION_PROBES")):
+            pv, _ = make_wave(seed, keys, "probe-%d" % p, n_batches=1,
+                              bad_rate=0.0)
+            probes.append(batch.run_probation_probe(pv[0], chip,
+                                                    rng=rng))
+        results["probes"] = probes
+        results["rejoined"] = (
+            reg.chip_state(chip) == health.STATE_HEALTHY
+            and not reg.excluded_chips())
+        results["ok"] = (results["ok"] and all(probes)
+                         and results["rejoined"])
+
+        # Full-width rejoin: routing reforms back over the chip and a
+        # final wave dispatches the WHOLE mesh, zero reformations.
+        results["reform_full_width"] = (
+            routing.reform_for(devices) == (devices, None))
+        identical, st = run_wave(seed, keys, "rejoined", hp, rng,
+                                 devices)
+        results["rejoin_wave"] = {
+            "host_identical": identical,
+            "mesh": st.get("mesh"),
+            "reformations": st.get("mesh_reformations", []),
+            "ok": (identical and st.get("mesh") == devices
+                   and not st.get("mesh_reformations")),
+        }
+        results["ok"] = (results["ok"]
+                         and results["reform_full_width"]
+                         and results["rejoin_wave"]["ok"])
+    finally:
+        devcache.set_default_cache(None)
+        batch.reset_device_health()
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=lambda s: int(s, 0),
+                    default=config.get("ED25519_TPU_SENTINEL_SOAK_SEED"))
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--chip", type=int, default=5,
+                    help="the persistently-corrupting chip (phase A)")
+    ap.add_argument("--json", action="store_true")
+    cfg = ap.parse_args(argv)
+
+    try:
+        import jax
+
+        n = len(jax.devices())
+    except (ImportError, RuntimeError):
+        n = 0
+    if n < cfg.devices:
+        print(f"sentinel_soak: need {cfg.devices} devices, have {n} "
+              f"(run with XLA_FLAGS=--xla_force_host_platform_"
+              f"device_count={cfg.devices})", file=sys.stderr)
+        os._exit(2)
+
+    summary = {"seed": cfg.seed, "devices": cfg.devices, "ok": True}
+    summary["persistent"] = run_persistent_corruptor(
+        cfg.seed, devices=cfg.devices, chip=cfg.chip)
+    summary["ok"] = summary["ok"] and summary["persistent"]["ok"]
+    summary["transient"] = run_transient_corruptor(
+        cfg.seed, devices=cfg.devices)
+    summary["ok"] = summary["ok"] and summary["transient"]["ok"]
+
+    if cfg.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    pers = summary["persistent"]
+    # The bench-harvest line (same shape as the other labs'): the
+    # headline is how fast a silent corruptor is diagnosed.
+    print(json.dumps({
+        "metric": "sentinel_soak",
+        "value": pers.get("detected_at_wave"),
+        "unit": "waves_to_quarantine_persistent_corruptor",
+        "k_wave_bound": pers.get("k_wave_bound"),
+        "attribution_exact": pers.get("attribution_exact"),
+        "available_fraction_after_quarantine":
+            pers.get("reformed", {}).get("available_fraction"),
+        "reformed_rung": pers.get("reformed", {}).get("reformed_rung"),
+        "flip_accept_caught": pers.get("flip_accept", {}).get("ok"),
+        "transient_rejoined": summary["transient"].get("rejoined"),
+        "ok": summary["ok"],
+    }))
+    print("SENTINEL_SOAK", json.dumps(summary))
+    if not summary["ok"]:
+        print(f"VIOLATION: sentinel_soak gates failed "
+              f"(replay with --seed {cfg.seed:#x})", file=sys.stderr)
+    sys.stdout.flush()
+    # Same teardown discipline as the other labs: never let interpreter
+    # finalization run with a lane worker parked in the runtime.
+    batch._DeviceLane.reset_all(timeout=30.0)
+    os._exit(0 if summary["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
