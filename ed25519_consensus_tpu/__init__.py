@@ -9,7 +9,17 @@ device meshes for large batches.
 
 Public surface mirrors reference src/lib.rs:6-16."""
 
-from . import batch, devcache, faults, health, routing, serde, service, tenancy
+from . import (
+    batch,
+    devcache,
+    faults,
+    federation,
+    health,
+    routing,
+    serde,
+    service,
+    tenancy,
+)
 from .error import (
     Error,
     InvalidSignature,
@@ -43,6 +53,7 @@ __all__ = [
     "batch",
     "devcache",
     "faults",
+    "federation",
     "health",
     "routing",
     "serde",

@@ -81,6 +81,7 @@ _FLOAT_DTYPES = frozenset(
 # invocation passes it explicitly.)
 _CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
                   "faults.py", "devcache.py", "tenancy.py",
+                  "federation.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
                   "tools/sentinel_soak.py")
 _CL004_ALLOWED = {
@@ -112,6 +113,7 @@ _LOCK_CONSTRUCTORS = frozenset(
      "BoundedSemaphore", "Barrier"))
 
 _CL006_MODULES = ("batch.py", "service.py", "tenancy.py",
+                  "federation.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
                   "tools/sentinel_soak.py")
 _CL005_SECRET_ATTRS = frozenset(("s", "prefix"))

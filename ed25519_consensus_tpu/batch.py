@@ -721,6 +721,33 @@ class Verifier:
             return b"".join(k.to_bytes() for k in self._key_index)
         return b"".join(k.to_bytes() for k in self._materialized())
 
+    def content_digest(self) -> "bytes | None":
+        """Content address of the QUEUED BATCH itself (round 11, the
+        service layer's intra-wave dedup key): SHA-256 over the batch
+        size, the canonical keyset blob, the per-signature group ids,
+        and the flat (s, R, k) queue-order buffers.  Since the
+        challenge k = H(R‖A‖M) binds the message, two verifiers share
+        a digest iff they received byte-identical (vk, sig, msg)
+        queue streams — exactly the "identical concurrent submission"
+        the dedup fans one ladder-decided verdict out to.
+
+        None when the digest cannot vouch for the contents: queue-
+        order buffers not live (the coalescing map was exposed and may
+        have been mutated count-neutrally) or the batch was
+        `invalidate()`d out-of-band (intent is not content).  A None
+        digest simply never dedups — full verification is the safe
+        default."""
+        if not self._buffers_live() or self._invalid is not None:
+            return None
+        h = hashlib.sha256(b"ed25519-tpu-batch-content-v1")
+        h.update(self.batch_size.to_bytes(8, "little"))
+        h.update(self._canonical_keyset_blob() or b"")
+        h.update(self._gid.tobytes())
+        h.update(bytes(self._s_buf))
+        h.update(bytes(self._r_buf))
+        h.update(bytes(self._k_buf))
+        return h.digest()
+
     @property
     def invalid_reason(self) -> "str | None":
         """The `invalidate()` reason, or None when the batch has not
@@ -2945,7 +2972,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     return _finish(verdicts)
 
 
-def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
+def warm_device_shapes(verifier, rng=None, chunk: int = 8,
+                       mesh: int = 0) -> None:
     """Compile the ONE device kernel shape verify_many dispatches for
     batches shaped like `verifier`, OUTSIDE the racing scheduler.
 
@@ -2954,7 +2982,20 @@ def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
     remote-compile tunnel, during which the host lane drains every batch
     and the probe never resolves — so benches/services should warm the
     shape once, before the first racing call.  No-op (raises nothing) if
-    staging fails or no device backend is available."""
+    staging fails or no device backend is available.
+
+    `mesh` > 1 (round 11, ROADMAP item 1(c) follow-up) ALSO warms the
+    sharded executable at that width AND at the N/2 REFORMATION rung:
+    a chip loss mid-wave reforms the mesh onto the surviving half
+    (routing.reform_for), and without this pre-warm the reformed
+    rung's very first dispatch sits in a first-shape compile — the
+    scheduler's compile-grace window (minutes) exactly when the
+    service is already degraded and latency matters most.  With both
+    rungs warm, a reform immediately after warm-up dispatches under
+    the NORMAL turnaround deadline (msm.shape_completed keys the
+    grace; tests/test_mesh_degrade.py pins this).  The single-device
+    floor of the ladder is the cold shape the un-meshed warm above
+    already covers."""
     from .ops import msm
 
     try:
@@ -2972,6 +3013,28 @@ def warm_device_shapes(verifier, rng=None, chunk: int = 8) -> None:
         msm.mark_shape_completed(dd.shape[0], dd.shape[2])
     except Exception:
         return  # warming is an optimization; the scheduler still works
+    mesh = _health.normalize_mesh(mesh)
+    if mesh > 1:
+        try:
+            from .parallel import sharded_msm as _sh
+
+            # The requested width first, then the N/2 reformation rung
+            # (descending, so a mid-warm failure still leaves the
+            # production width warm).  Each rung is its own executable
+            # with its own shard pad; the dispatch takes the device
+            # lock itself.
+            for rung in (mesh, mesh // 2):
+                if rung < 2:
+                    break
+                spad = _sh.shard_pad(staged.n_device_terms, rung)
+                sd, sp = staged.device_operands(
+                    lambda n, spad=spad: spad)
+                sdd = np.stack([sd] * chunk)
+                spp = np.stack([sp] * chunk)
+                np.asarray(_sh.sharded_window_sums_many(sdd, spp, rung))
+                msm.mark_shape_completed(chunk, sdd.shape[2], rung)
+        except Exception:
+            pass  # same contract: rung warming is optional
     try:
         # Also warm the devcache hot-path executable at this shape — a
         # DIFFERENT executable from the cold kernel at the same lane

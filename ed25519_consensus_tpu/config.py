@@ -234,6 +234,39 @@ KNOBS: "dict[str, Knob]" = dict([
        "Default seed for tools/sentinel_soak.py's corrupting-chip "
        "storms and workload construction (the run is a pure function "
        "of it)."),
+    _k("ED25519_TPU_REPLICA_SUSPICION_THRESHOLD", "float", 3.0,
+       "Per-replica decayed suspicion score at which the federation "
+       "ReplicaRegistry starts DRAINING a replica (fatal replica "
+       "errors eject directly; transient/ambiguous evidence "
+       "accumulates here)."),
+    _k("ED25519_TPU_REPLICA_SUSPICION_HALF_LIFE", "float", 300.0,
+       "Half-life (registry-clock seconds) of per-replica suspicion "
+       "scores; decay below half the threshold relaxes an ejected "
+       "replica to probation eligibility."),
+    _k("ED25519_TPU_REPLICA_PROBES", "int", 2,
+       "Consecutive clean host-verified probe batches an ejected "
+       "replica must pass (federation.ReplicaSet probe cycle) before "
+       "it rejoins the affinity ring."),
+    _k("ED25519_TPU_REPLICA_SPILLOVER", "opt-out", True,
+       "Set to 0/false/no to disable affinity-preserving spillover: "
+       "a degraded/overloaded replica then sheds submissions instead "
+       "of handing them to the next replica in rendezvous order "
+       "(consensus-class still tries every live replica either way)."),
+    _k("ED25519_TPU_REPLICA_DEGRADED_FRAC", "float", 0.5,
+       "Effective-capacity fraction at or below which a replica is "
+       "treated as DEGRADED by the federation router: lower-class "
+       "traffic spills to healthy peers before that replica sheds "
+       "users (a replica at the 2-chip rung sheds load, not users)."),
+    _k("ED25519_TPU_FLEET_LAB_SEED", "int", 0xF1EE7,
+       "Default seed for tools/traffic_lab.py --fleet mode's chain "
+       "matrix, arrival processes, and replica-chaos schedule (the "
+       "run is a pure function of it)."),
+    _k("ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE", "opt-in", False,
+       "Report-only tenant-quota auto-sizing: derive per-tenant "
+       "devcache quota SUGGESTIONS from observed hit rates "
+       "(devcache.suggest_tenant_quotas) and publish them in "
+       "stats()[\"quota_suggestions\"]; never changes the armed "
+       "quotas."),
 ])
 
 

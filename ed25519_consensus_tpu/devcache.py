@@ -103,6 +103,7 @@ from .utils import metrics as _metrics
 __all__ = [
     "ResidentKeyset", "DeviceOperandCache", "default_cache",
     "set_default_cache", "keyset_digest", "KIND_HEAD", "KIND_TABLES",
+    "suggest_tenant_quotas",
 ]
 
 # Entry kinds (round 8): a keyset digest can hold up to two resident
@@ -235,7 +236,17 @@ class DeviceOperandCache:
 
     def __init__(self, budget_bytes: "int | None" = None,
                  enabled: "bool | None" = None,
-                 tenant_quota_bytes: "int | None" = None):
+                 tenant_quota_bytes: "int | None" = None,
+                 namespace: str = ""):
+        # Residency NAMESPACE (round 11, federation): each replica of a
+        # ReplicaSet owns its own cache instance labelled with its
+        # namespace, so per-replica residency — the thing keyset
+        # affinity keeps hot — is accounted, dropped, and published
+        # per replica.  "" (the default) is the classic process-wide
+        # cache with the historical gauge names; a namespaced cache
+        # publishes devcache_<ns>_* gauges instead, so M replicas never
+        # clobber one another's observability.
+        self.namespace = str(namespace)
         if enabled is None:
             enabled = _config.get("ED25519_TPU_DEVCACHE")
         if budget_bytes is None:
@@ -724,10 +735,25 @@ class DeviceOperandCache:
 
     # -- observability -----------------------------------------------------
 
+    def quota_suggestions(self) -> "dict[str, int]":
+        """Report-only per-tenant quota suggestions derived from the
+        OBSERVED lookup pattern (`suggest_tenant_quotas` over
+        `tenant_stats()` — the ROADMAP item 4 auto-sizing follow-up).
+        Never changes the armed quotas: an operator reads these next
+        to the hit rates and decides.  Empty unless the
+        ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE knob is on."""
+        if not _config.get("ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE"):
+            return {}
+        return suggest_tenant_quotas(self.tenant_stats(),
+                                     self.budget_bytes)
+
     def stats(self) -> dict:
+        suggestions = self.quota_suggestions()
         with self._lock:
             return {
                 "enabled": self.enabled,
+                "namespace": self.namespace,
+                "quota_suggestions": suggestions,
                 "budget_bytes": self.budget_bytes,
                 "tenant_quota_bytes": self.tenant_quota_bytes,
                 "resident_bytes": sum(
@@ -745,18 +771,30 @@ class DeviceOperandCache:
     def _publish(self) -> None:
         """Mirror the levels into the process gauge registry
         (utils.metrics): devcache_hits/misses/evictions/resident_bytes
-        and friends — what soak tooling and operators watch."""
-        st = self.stats()
-        _metrics.set_gauges({
-            "devcache_hits": st["hits"],
-            "devcache_misses": st["misses"],
-            "devcache_evictions": st["evictions"],
-            "devcache_resident_bytes": st["resident_bytes"],
-            "devcache_resident_keysets": st["resident_keysets"],
-            "devcache_restages": (st["restage_hash_mismatch"]
-                                  + st["stale_epoch"]),
-            "devcache_epoch": st["epoch"],
-        })
+        and friends — what soak tooling and operators watch.  A
+        namespaced (per-replica) cache publishes devcache_<ns>_* so
+        replicas never clobber one another's gauges.  Reads a minimal
+        counter snapshot directly — NOT stats() — because this runs on
+        every lookup/build and stats() now also derives the
+        report-only quota suggestions (a full per-tenant entry scan
+        when the autosize knob is on; observability callers pay it,
+        the hot path must not)."""
+        with self._lock:
+            c = self.counters
+            snap = {
+                "hits": c["hits"], "misses": c["misses"],
+                "evictions": c["evictions"],
+                "restages": (c["restage_hash_mismatch"]
+                             + c["stale_epoch"]),
+                "resident_bytes": sum(
+                    e.nbytes for e in self._entries.values()),
+                "resident_keysets": len({d for d, _k in self._entries}),
+                "epoch": self._epoch,
+            }
+        prefix = ("devcache_" if not self.namespace
+                  else f"devcache_{self.namespace}_")
+        _metrics.set_gauges(
+            {prefix + k: v for k, v in snap.items()})
 
     def __repr__(self):
         st = self.stats()
@@ -765,6 +803,43 @@ class DeviceOperandCache:
                 f"{st['resident_bytes']}B of {st['budget_bytes']}B, "
                 f"epoch={st['epoch']}, hits={st['hits']}, "
                 f"misses={st['misses']})")
+
+
+def suggest_tenant_quotas(tenant_stats: "dict[str, dict]",
+                          budget_bytes: int) -> "dict[str, int]":
+    """Per-tenant quota SUGGESTIONS from observed demand (ROADMAP item
+    4 follow-up; report-only — `DeviceOperandCache.quota_suggestions`
+    gates publication behind ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE).
+
+    A pure function of (tenant_stats snapshot, budget): each tenant's
+    demand weight is
+
+        lookups · (1 + miss_rate)
+
+    — its observed traffic share, tilted toward tenants whose hit rate
+    is LOW (a churning or under-provisioned tenant needs quota more
+    than one already serving every lookup from residency; a tenant
+    with hit rate 1.0 weighs exactly its lookup share, one with hit
+    rate 0.0 weighs double).  The budget is split proportionally and
+    floored to ints, so Σ suggestions ≤ budget always; tenants with no
+    observed lookups suggest 0 (no evidence, no reservation — the
+    shared pool serves them until they show up).  Suggestions are
+    operator input, never armed state: eviction still only ever obeys
+    `tenant_quota_bytes`."""
+    budget = max(0, int(budget_bytes))
+    weights = {}
+    for tenant, st in tenant_stats.items():
+        looked = st.get("hits", 0) + st.get("misses", 0)
+        if looked <= 0:
+            continue
+        hit_rate = st.get("hit_rate")
+        miss_rate = 1.0 - (hit_rate if hit_rate is not None else 1.0)
+        weights[tenant] = looked * (1.0 + miss_rate)
+    total = sum(weights.values())
+    if total <= 0 or budget <= 0:
+        return {t: 0 for t in weights}
+    return {t: int(budget * w / total)
+            for t, w in sorted(weights.items())}
 
 
 # -- process default (same injectable-singleton idiom as routing.py) ------

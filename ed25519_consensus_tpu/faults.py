@@ -53,15 +53,18 @@ from contextlib import contextmanager
 import numpy as np
 
 __all__ = [
-    "SITE_LANE", "SITE_SHARDED", "SITE_DEVCACHE", "InjectedFault",
+    "SITE_LANE", "SITE_SHARDED", "SITE_DEVCACHE", "SITE_REPLICA",
+    "InjectedFault",
     "TransientDispatchError", "FatalChipError",
+    "ReplicaCrashError", "ReplicaWedgeError",
     "LaneDeathSignal",
     "Fault", "ErrorOn", "TypedErrorOn", "StallFor", "FlappingLink",
     "CorruptSum", "CorruptChipSum",
     "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
     "RotateTenant", "ChipLoss", "LinkFlap",
+    "ReplicaCrash", "ReplicaWedge", "SplitCapacity",
     "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
-    "mesh_plan", "sentinel_plan", "typed_error_plan",
+    "mesh_plan", "sentinel_plan", "typed_error_plan", "replica_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
 ]
@@ -72,6 +75,12 @@ SITE_SHARDED = "sharded"
 # index" counts cache lookups, and ctx.payload is the cache object
 # itself, so cache faults can evict/corrupt/stale deterministically.
 SITE_DEVCACHE = "devcache"
+# The federation layer's replica-pump boundary (federation.py): "call
+# index" counts ReplicaSet wave pumps ACROSS all replicas (in the
+# deterministic drive order), and ctx.payload is the Replica wrapper
+# being pumped, so whole-replica faults can target one replica out of
+# the fleet.
+SITE_REPLICA = "replica"
 
 
 class InjectedFault(RuntimeError):
@@ -107,6 +116,33 @@ class FatalChipError(InjectedFault):
         self.chips = tuple(int(c) for c in chips)
         self.heal_after = heal_after
         self.chips_marked = bool(chips_marked)
+
+
+class ReplicaCrashError(InjectedFault):
+    """A whole replica service died (host crash, OOM, runtime abort) —
+    the FATAL class at replica granularity: the federation layer
+    ejects the replica, re-issues its surrendered queue on peers with
+    fresh blinders, and revives it into the probation probe cycle."""
+
+    device_error_class = "fatal"
+
+    def __init__(self, msg: str, replica: int = 0):
+        super().__init__(msg)
+        self.replica = int(replica)
+
+
+class ReplicaWedgeError(InjectedFault):
+    """A replica wedged (mesh-wide PJRT hang, breaker stuck open): the
+    pump makes no progress.  Classified TRANSIENT — one wedge is a
+    strike, not a death — so repeated wedges walk the replica ladder
+    (suspicion → drain → eject) on accumulated evidence instead of
+    ejecting a replica that hiccuped once."""
+
+    device_error_class = "transient"
+
+    def __init__(self, msg: str, replica: int = 0):
+        super().__init__(msg)
+        self.replica = int(replica)
 
 
 class LaneDeathSignal(Exception):
@@ -468,6 +504,84 @@ class LinkFlap(Fault):
         reg.heal_chip(self.chip)
 
 
+class ReplicaCrash(Fault):
+    """Kill ONE replica of a federation AT its next pumped wave after
+    the fault window opens (SITE_REPLICA; ctx.payload is the Replica
+    wrapper, so the crash targets `replica` whatever the fleet's pump
+    interleaving).  Raises ReplicaCrashError — classified FATAL — so
+    the ReplicaSet ejects the replica, surrenders and re-issues its
+    queued work on peers (fresh blinders, never result reuse), and
+    later revives it into the probation cycle.
+
+    ONE event by nature: the fault latches after firing, so the
+    revived replica's probe pumps do not re-crash it (replay stays
+    deterministic — the latch is a pure consequence of the first
+    matching (index, replica) pair in the pump stream)."""
+
+    def __init__(self, replica: int, on=0):
+        super().__init__(on=on, site=SITE_REPLICA)
+        self.replica = int(replica)
+        self._fired = [False]
+
+    def before(self, ctx):
+        if self._fired[0]:
+            return
+        if ctx.payload is None or \
+                getattr(ctx.payload, "rid", None) != self.replica:
+            return
+        self._fired[0] = True
+        raise ReplicaCrashError(
+            f"injected replica crash: replica {self.replica} died "
+            f"mid-wave (call={ctx.index})", replica=self.replica)
+
+
+class ReplicaWedge(Fault):
+    """Replica `replica`'s pumps WEDGE for the faulted window: each
+    matching pump advances a virtual clock by `seconds` (the wall time
+    a wedged runtime burns) and raises ReplicaWedgeError — classified
+    TRANSIENT, so the federation ladder ejects only on the
+    accumulated-evidence path (suspicion → drain → eject), exactly the
+    breaker-stuck-open shape the replica ladder exists for."""
+
+    def __init__(self, replica: int, on=0, seconds: float = 5.0):
+        super().__init__(on=on, site=SITE_REPLICA)
+        self.replica = int(replica)
+        self.seconds = float(seconds)
+
+    def before(self, ctx):
+        if ctx.payload is None or \
+                getattr(ctx.payload, "rid", None) != self.replica:
+            return
+        clock = ctx.clock
+        if clock is not None and getattr(clock, "virtual", False):
+            clock.advance(self.seconds)
+        raise ReplicaWedgeError(
+            f"injected replica wedge: replica {self.replica} made no "
+            f"progress (call={ctx.index})", replica=self.replica)
+
+
+class SplitCapacity(Fault):
+    """Split-capacity event: replica `replica` loses `frac` of its
+    capacity (half its chips die inside the replica's own mesh) at the
+    faulted pump — modelled by setting the Replica wrapper's
+    `degraded_frac`, which the federation router reads as the
+    replica's effective-capacity fraction.  No raise: the replica
+    keeps serving — degraded — and the affinity router's spillover
+    policy (lower classes to healthy peers BEFORE shedding users)
+    engages on the next submission."""
+
+    def __init__(self, replica: int, on=0, frac: float = 0.5):
+        super().__init__(on=on, site=SITE_REPLICA)
+        self.replica = int(replica)
+        self.frac = float(frac)
+
+    def before(self, ctx):
+        if ctx.payload is None or \
+                getattr(ctx.payload, "rid", None) != self.replica:
+            return
+        ctx.payload.degraded_frac = self.frac
+
+
 class CorruptResidentEntry(Fault):
     """Flip bytes in the looked-up resident keyset entry's HOST mirror
     (deterministically from the plan seed) — modelling rotted resident
@@ -780,6 +894,39 @@ def sentinel_plan(seed: int, kind: str, chip: int = 0, on=None,
                                  site=site)]
     else:
         raise ValueError(f"unknown sentinel fault kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
+def replica_plan(seed: int, kind: str, replica: int = 0, at: int = 0,
+                 length: int = 1, seconds: float = 5.0,
+                 frac: float = 0.5) -> FaultPlan:
+    """A whole-replica fault schedule over the federation pump stream
+    (SITE_REPLICA; indices count ReplicaSet pumps across the fleet —
+    tools/traffic_lab.py --fleet replays these from a seed):
+
+    * ``"crash"``          — replica `replica` dies at its first pump
+      with index ≥ `at` (ReplicaCrash latches after one firing, so the
+      revived replica's probes are not re-killed);
+    * ``"wedge"``          — the replica's pumps in [at, at+length)
+      wedge for `seconds` each (virtual clocks advance) — the
+      accumulated-evidence path to drain → eject;
+    * ``"split-capacity"`` — the replica loses `frac` of its capacity
+      at pump `at` (degraded, still serving: the spillover — not the
+      eject — machinery is under test).
+
+    Same replay property as every other plan: decisions are pure
+    functions of (seed, site, call index, pump interleaving)."""
+    if kind == "crash":
+        faults = [ReplicaCrash(replica, on=lambda i, a=at: i >= a)]
+    elif kind == "wedge":
+        faults = [ReplicaWedge(replica,
+                               on=range(at, at + max(1, length)),
+                               seconds=seconds)]
+    elif kind == "split-capacity":
+        faults = [SplitCapacity(replica, on=lambda i, a=at: i >= a,
+                                frac=frac)]
+    else:
+        raise ValueError(f"unknown replica fault kind {kind!r}")
     return FaultPlan(faults, seed=seed)
 
 

@@ -1,0 +1,669 @@
+"""Federated replica meshes: one front door over M replica services
+(ROADMAP item 4 — serve millions of users from M replica meshes).
+
+Everything below this module is one process, one mesh: PR 8/10 made a
+single mesh survive chip loss and diagnose silent corruption, but a
+WHOLE-REPLICA failure — host crash, mesh-wide PJRT wedge, a breaker
+stuck open — still takes the entire service down.  `ReplicaSet` is the
+federation layer that closes that gap: M `VerifyService` replicas
+(each its own mesh slice / virtual-device group in a real deployment,
+each with its own breaker, its own namespaced device-operand cache,
+its own capacity), behind consistent-hash keyset/tenant → replica
+affinity so residency stays hot per replica.
+
+The replica escalation ladder (docs/failure-model.md), one level above
+the chip ladder and deliberately one rung richer — a replica holds
+admitted work a chip does not:
+
+1. **Affinity** — every submission routes by rendezvous hashing over
+   (keyset digest, tenant) (`routing.replica_affinity_order`): a
+   recurring validator keyset always lands on the same replica, whose
+   devcache therefore serves it hot.  The ORDER, not just the winner,
+   is policy: spillover follows the same deterministic sequence.
+2. **Spillover** — a replica that is DEGRADED (its effective capacity
+   fell to the ED25519_TPU_REPLICA_DEGRADED_FRAC rung — e.g. the PR 8
+   watermark shrink at the 2-chip rung) or OVERLOADED hands
+   lower-class submissions to the next replica in affinity order
+   BEFORE shedding users; consensus-class tries every live replica —
+   it never loses admission while any replica is alive (only every
+   queue physically full can reject it, the same contract the
+   per-class watermarks enforce one level down).
+3. **Suspect → drain** — classified evidence
+   (`health.classify_device_error` at replica granularity) lands in
+   the `health.ReplicaRegistry` suspicion ledger: transient errors
+   (wedge shapes) and ambiguous failures accumulate decaying
+   suspicion; crossing the threshold DRAINS the replica — no new
+   work, queued work finishes normally.
+4. **Eject + re-issue** — a drained-empty or fatally-failed (crash)
+   replica is EJECTED: its still-queued requests are surrendered
+   (`VerifyService.surrender_pending` — tickets intact) and re-issued
+   on peers in affinity order; a re-issued batch is RE-VERIFIED there
+   with fresh blinders — re-issue is re-verification, never verdict
+   transfer.  If no peer can admit one, the federation layer decides
+   it on the exact host path directly — the ladder's floor, so an
+   admitted request ALWAYS resolves (zero lost, the service-layer
+   contract lifted to fleet scope).  The ejected replica's devcache
+   namespace drops wholesale (its device memory is gone or
+   untrusted).
+5. **Probe → rejoin** — suspicion decays, eject relaxes to PROBATION
+   (read-side, the ChipRegistry hysteresis), and the replica — revived
+   through the service factory if it crashed — must pass
+   ED25519_TPU_REPLICA_PROBES consecutive HOST-VERIFIED probe batches
+   (truth known by construction, compared against the replica's
+   verdict) before the affinity ring places it again.  A failing
+   probe re-ejects with suspicion pinned.
+
+Soundness (docs/consensus-invariants.md, "why federation cannot
+affect verdicts"): replica choice is PLACEMENT, never math — every
+verdict is decided by some replica's verify_many ladder or by the
+exact host path; affinity, spillover, suspicion, and ejection choose
+WHO decides and WHEN, never WHAT the answer is.
+
+Determinism: no wall-clock reads (all time from the injected
+`health.Clock`), no module-global mutable state (the ReplicaSet and
+its registry are injectable objects — consensuslint CL004 covers this
+module), and the whole-replica fault seam (`faults.SITE_REPLICA`:
+ReplicaCrash / ReplicaWedge / SplitCapacity plans) makes every rung
+of the ladder replayable from a seed (tools/traffic_lab.py --fleet).
+"""
+
+import random
+import threading
+
+from . import batch as _batch
+from . import config as _config
+from . import devcache as _devcache
+from . import faults as _faults
+from . import health as _health
+from . import routing as _routing
+from . import service as _service
+from . import tenancy as _tenancy
+from .utils import metrics as _metrics
+
+__all__ = ["FederatedTicket", "Replica", "ReplicaSet"]
+
+
+class FederatedTicket:
+    """Handle for one federated submission.  Points at the underlying
+    replica `VerifyTicket`, and is RE-POINTED transparently when the
+    federation layer re-issues the request on a peer (whole-replica
+    failover) — the waiter never learns, it just gets its verdict.
+    `replica_trail` records every placement for audit."""
+
+    __slots__ = ("_lock", "_inner", "replica_id", "replica_trail")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inner = None
+        self.replica_id = None
+        self.replica_trail = []
+
+    def _point_at(self, ticket, rid: int) -> None:
+        with self._lock:
+            self._inner = ticket
+            self.replica_id = rid
+            self.replica_trail.append(rid)
+
+    def _current(self):
+        with self._lock:
+            return self._inner
+
+    def done(self) -> bool:
+        t = self._current()
+        return t is not None and t.done()
+
+    def result(self, timeout: "float | None" = None) -> bool:
+        """Block (wall time) for the outcome; returns the verdict or
+        raises the explicit error.  Waits in short slices because the
+        inner ticket can be re-pointed mid-wait by a failover."""
+        wall = _health.SYSTEM_CLOCK.monotonic
+        deadline = None if timeout is None else wall() + float(timeout)
+        while True:
+            t = self._current()
+            remaining = None if deadline is None else deadline - wall()
+            if t is not None:
+                if remaining is None:
+                    try:
+                        return t.result(0.1)
+                    except TimeoutError:
+                        continue
+                if t.done() or remaining > 0:
+                    try:
+                        return t.result(min(0.1, max(0.0, remaining)))
+                    except TimeoutError:
+                        if t is not self._current():
+                            continue  # re-pointed: keep waiting
+                        if wall() >= deadline:
+                            raise
+                        continue
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError("federated result not ready")
+
+
+class Replica:
+    """One managed replica: identity, its `VerifyService`, its
+    NAMESPACED device-operand cache, and the degraded-capacity seam
+    the SplitCapacity fault (and a real per-replica capacity monitor)
+    writes.  Pure placement/observability state — never verdicts."""
+
+    __slots__ = ("rid", "service", "cache", "degraded_frac", "pumps",
+                 "crashed")
+
+    def __init__(self, rid: int, service, cache):
+        self.rid = int(rid)
+        self.service = service
+        self.cache = cache
+        # None = derive from the service's own effective capacity (the
+        # PR 8 watermark shrink); a float is an externally-reported
+        # fraction (SplitCapacity fault / operator / fleet monitor).
+        self.degraded_frac = None
+        self.pumps = 0
+        self.crashed = False
+
+    def capacity_fraction(self) -> float:
+        """This replica's live effective-capacity fraction: the
+        reported seam when set, else effective/configured from its own
+        service (which already folds in chip loss + quarantine)."""
+        if self.degraded_frac is not None:
+            return float(self.degraded_frac)
+        svc = self.service
+        cap = max(1, svc.capacity_sigs)
+        return svc.effective_capacity_sigs() / cap
+
+
+class ReplicaSet:
+    """The federation front door: M replicas, affinity routing,
+    spillover, the replica escalation ladder, and fleet-scope
+    zero-lost (module docstring).
+
+    * `replicas` — replica count M (ids 0..M−1).
+    * `service_factory(rid, clock, cache)` — builds one replica's
+      `VerifyService`; called at construction and again at REVIVAL
+      (a crashed replica's process restarts).  Must return an
+      `auto_start=False` service: the ReplicaSet is the dispatcher
+      (its `process_once` pumps every replica — deterministic under a
+      FakeClock, which is what the fleet lab replays).  The default
+      factory builds host-defaulted services with per-replica breaker
+      seeds.
+    * `clock` — the fleet timeline (registry decay, probes, services).
+    * `registry` — injectable `health.ReplicaRegistry`.
+
+    Thread semantics: `submit` from any number of threads; one driver
+    calls `process_once` (or `pump_forever` from a dedicated thread).
+    Internal state is lock-guarded; registry and services have their
+    own documented contracts."""
+
+    def __init__(self, replicas: int = 3,
+                 service_factory=None,
+                 clock: "_health.Clock | None" = None,
+                 registry: "_health.ReplicaRegistry | None" = None,
+                 capacity_sigs: int = 65536,
+                 devcache_budget_bytes: "int | None" = None,
+                 probe_seed: int = 0):
+        if replicas < 1:
+            raise ValueError("a federation needs at least one replica")
+        self._clock = clock if clock is not None else _health.SYSTEM_CLOCK
+        self.registry = registry if registry is not None \
+            else _health.ReplicaRegistry(clock=self._clock)
+        self.registry.set_clock(self._clock)
+        self.capacity_sigs = int(capacity_sigs)
+        self._factory = (service_factory if service_factory is not None
+                         else self._default_factory)
+        self._lock = threading.Lock()
+        self._probe_seed = int(probe_seed)
+        self._probe_ord = 0
+        self._closed = False
+        self.replicas: "dict[int, Replica]" = {}
+        # rid -> {id(inner ticket): (FederatedTicket, _Request)} — the
+        # re-issue bridge: ejecting a replica looks its surrendered
+        # requests up here to re-point their federated tickets.
+        # Pruned of resolved entries on every pump (bounded by the
+        # replica's unresolved depth).
+        self._tracked: "dict[int, dict]" = {}
+        self.totals = {
+            "submitted": 0, "affinity_hits": 0, "spillovers": 0,
+            "degraded_spills": 0, "rejected_overloaded": 0,
+            "reissued": 0, "host_floor": 0, "ejections": 0,
+            "drains_started": 0, "rejoins": 0, "revivals": 0,
+            "probes": 0, "probe_failures": 0,
+        }
+        self.error_classes = {_health.ERROR_TRANSIENT: 0,
+                              _health.ERROR_FATAL: 0,
+                              _health.ERROR_AMBIGUOUS: 0}
+        for rid in range(int(replicas)):
+            cache_budget = devcache_budget_bytes
+            cache = _devcache.DeviceOperandCache(
+                budget_bytes=cache_budget, namespace=f"r{rid}")
+            svc = self._factory(rid, self._clock, cache)
+            self.replicas[rid] = Replica(rid, svc, cache)
+            self._tracked[rid] = {}
+
+    def _default_factory(self, rid: int, clock, cache):
+        return _service.VerifyService(
+            capacity_sigs=self.capacity_sigs, clock=clock,
+            auto_start=False, replica_id=f"r{rid}", cache=cache,
+            breaker_seed=rid)
+
+    # -- affinity + admission ---------------------------------------------
+
+    @staticmethod
+    def _digest_of(verifier) -> "bytes | None":
+        blob = verifier._canonical_keyset_blob()
+        return _devcache.keyset_digest(blob) if blob else None
+
+    def _degraded(self, rep: Replica) -> bool:
+        frac = _config.get("ED25519_TPU_REPLICA_DEGRADED_FRAC")
+        return rep.capacity_fraction() <= frac
+
+    def _candidates(self, digest, tenant: str, cls: str
+                    ) -> "tuple[tuple[int, ...], int]":
+        """(candidate rids in try order, first-choice rid).  The try
+        order is the affinity order with non-accepting replicas
+        removed and — for lower classes, spillover armed — DEGRADED
+        replicas moved to the back: a degraded replica sheds load to
+        healthy peers before it sheds users, but remains the last
+        resort before an Overloaded.  Consensus-class additionally
+        appends DRAINING replicas: admission for consensus outranks
+        the drain (it never loses admission while any replica is
+        alive)."""
+        order = _routing.replica_affinity_order(
+            digest, tenant, sorted(self.replicas))
+        first = order[0] if order else None
+        accepting = [r for r in order if self.registry.accepting(r)]
+        spill = _config.get("ED25519_TPU_REPLICA_SPILLOVER")
+        if cls != _tenancy.CLASS_CONSENSUS:
+            if not spill:
+                # Knob off: lower classes get exactly their affinity
+                # target — an overloaded/degraded home then SHEDS
+                # instead of spilling (consensus is not knob-gated).
+                accepting = accepting[:1]
+            else:
+                healthy = [r for r in accepting
+                           if not self._degraded(self.replicas[r])]
+                degraded = [r for r in accepting
+                            if self._degraded(self.replicas[r])]
+                accepting = healthy + degraded
+        if cls == _tenancy.CLASS_CONSENSUS:
+            draining = self.registry.draining_replicas()
+            accepting = accepting + [r for r in order if r in draining]
+        return tuple(accepting), first
+
+    def submit(self, entries, deadline: "float | None" = None,
+               timeout: "float | None" = None,
+               cls: "str | None" = None,
+               tenant: "str | None" = None) -> FederatedTicket:
+        """Submit one batch to the fleet; returns a `FederatedTicket`.
+        Placement: consistent-hash affinity, then spillover down the
+        same order (module docstring rungs 1-2).  Raises `Overloaded`
+        only when NO candidate replica admitted the batch and
+        `ServiceClosed` after `close()` — an admitted request then
+        resolves even across a replica death (rung 4)."""
+        if cls is None:
+            cls = _tenancy.CLASS_MEMPOOL
+        _tenancy.class_rank(cls)
+        if isinstance(entries, _batch.Verifier):
+            v = entries
+        else:
+            v = _batch.Verifier()
+            v.queue_bulk(list(entries))
+        with self._lock:
+            if self._closed:
+                raise _service.ServiceClosed()
+        if timeout is not None:
+            t = self._clock.monotonic() + float(timeout)
+            deadline = t if deadline is None else min(deadline, t)
+        digest = self._digest_of(v)
+        tenant_name = tenant if tenant is not None \
+            else _tenancy.DEFAULT_TENANT
+        candidates, first = self._candidates(digest, tenant_name, cls)
+        self.totals["submitted"] += 1
+        last_exc = None
+        for i, rid in enumerate(candidates):
+            rep = self.replicas[rid]
+            try:
+                ticket = rep.service.submit(
+                    v, deadline=deadline, cls=cls, tenant=tenant)
+            except _service.Overloaded as exc:
+                last_exc = exc
+                continue
+            fed = FederatedTicket()
+            fed._point_at(ticket, rid)
+            with self._lock:
+                self._tracked[rid][id(ticket)] = (fed, v, deadline,
+                                                  cls, tenant_name)
+            # Ejection race: between the candidate check and the
+            # enqueue above, the dispatcher thread may have ejected
+            # this replica — its surrender sweep ran BEFORE our
+            # request landed, and an ejected (or probation) replica is
+            # never pumped, so without this re-check the request would
+            # sit unresolved forever.  The sweep is idempotent
+            # surrender + re-issue, no fresh ejection accounting.
+            if self.registry.state_of(rid) in (
+                    _health.REPLICA_EJECTED, _health.REPLICA_PROBATION):
+                self._sweep_ejected(rep)
+            if rid == first:
+                self.totals["affinity_hits"] += 1
+            else:
+                self.totals["spillovers"] += 1
+                if (first is not None
+                        and self.registry.accepting(first)
+                        and self._degraded(self.replicas[first])):
+                    # The first choice was alive but degraded: this is
+                    # the shed-load-not-users spill, distinct from a
+                    # failover spill off an ejected/draining replica.
+                    self.totals["degraded_spills"] += 1
+                _metrics.record_fault("federation_spillover")
+            return fed
+        self.totals["rejected_overloaded"] += 1
+        _metrics.record_fault("federation_reject_overloaded")
+        if last_exc is not None:
+            raise last_exc
+        raise _service.Overloaded(
+            f"no replica available for {cls}-class submission "
+            f"({len(self.replicas)} configured, "
+            f"{len(candidates)} candidates)")
+
+    # -- the dispatcher ----------------------------------------------------
+
+    def _supervised(self, rep: Replica, fn):
+        """Run one replica-scoped call through the whole-replica fault
+        seam under supervision: ANY exception becomes classified
+        evidence in the replica ladder (fatal → eject + re-issue,
+        transient/ambiguous → suspicion), never an escape — the
+        federation layer must outlive any one replica's death, which
+        is its entire reason to exist.  Returns (ok, value)."""
+        try:
+            return True, _faults.run_device_call(
+                _faults.SITE_REPLICA, fn, clock=self._clock,
+                payload=rep)
+        except Exception as exc:
+            self._on_replica_error(rep, exc)
+            return False, None
+
+    def _on_replica_error(self, rep: Replica, exc: Exception) -> None:
+        ev = _health.classify_device_error(exc)
+        self.error_classes[ev.cls] += 1
+        state = self.registry.state_of(rep.rid)
+        if state in (_health.REPLICA_EJECTED,
+                     _health.REPLICA_PROBATION):
+            # Already off placement: a failure here can only be a
+            # probation probe (or a stale pump racing the eject) —
+            # _run_probes records the probe failure; a SECOND ejection
+            # would double-count totals and re-drop the cache for the
+            # same outage.  A fatal class still marks the service
+            # crashed so revival rebuilds it.
+            if ev.cls == _health.ERROR_FATAL:
+                rep.crashed = True
+            return
+        if ev.cls == _health.ERROR_FATAL:
+            self._eject(rep, f"fatal replica error: {ev.reason}",
+                        crashed=True)
+            return
+        weight = (_health.REPLICA_TRANSIENT_SUSPICION
+                  if ev.cls == _health.ERROR_TRANSIENT
+                  else _health.REPLICA_AMBIGUOUS_SUSPICION)
+        before = self.registry.state_of(rep.rid)
+        state = self.registry.record_suspicion(
+            rep.rid, weight, f"{ev.cls}: {ev.reason}")
+        if state == _health.REPLICA_DRAINING \
+                and before != _health.REPLICA_DRAINING:
+            self.totals["drains_started"] += 1
+            _metrics.record_fault("replica_drain_started")
+
+    def _eject(self, rep: Replica, reason: str,
+               crashed: bool = False) -> None:
+        """Rung 4: eject the replica, surrender + re-issue its queue,
+        drop its residency namespace."""
+        self.registry.mark_ejected(rep.rid, reason)
+        self.totals["ejections"] += 1
+        _metrics.record_fault("replica_ejected")
+        rep.crashed = rep.crashed or crashed
+        rep.cache.drop_all(f"replica-ejected: {reason}")
+        self._sweep_ejected(rep)
+
+    def _sweep_ejected(self, rep: Replica) -> None:
+        """Surrender + re-issue everything still queued on an ejected
+        replica's service.  IDEMPOTENT (an empty queue sweeps to
+        nothing), so the ejection path, the submit-vs-eject race
+        re-check, revival, and close() can all call it without
+        double-counting ejections."""
+        pending = rep.service.surrender_pending()
+        with self._lock:
+            bridge = self._tracked[rep.rid]
+            self._tracked[rep.rid] = {}
+        for req in pending:
+            entry = bridge.pop(id(req.ticket), None)
+            self._reissue(req, entry, exclude=rep.rid)
+
+    def _reissue(self, req, entry, exclude: int) -> None:
+        """Re-issue one surrendered request on a peer (fresh blinders —
+        re-verification, never verdict transfer), falling to the exact
+        host path when no peer admits it: an admitted request ALWAYS
+        resolves."""
+        fed = entry[0] if entry is not None else None
+        tenant_name = entry[4] if entry is not None else (
+            req.tenant or _tenancy.DEFAULT_TENANT)
+        digest = self._digest_of(req.verifier)
+        if fed is not None:
+            candidates, _first = self._candidates(digest, tenant_name,
+                                                  req.cls)
+            for rid in candidates:
+                if rid == exclude:
+                    continue
+                rep = self.replicas[rid]
+                try:
+                    ticket = rep.service.submit(
+                        req.verifier, deadline=req.deadline,
+                        cls=req.cls, tenant=req.tenant)
+                except (_service.Overloaded, _service.ServiceClosed):
+                    # a closed peer (fleet shutdown sweep) is just an
+                    # unavailable candidate — the host floor below
+                    # still owes the ticket its resolution
+                    continue
+                self.totals["reissued"] += 1
+                _metrics.record_fault("federation_reissue")
+                fed._point_at(ticket, rid)
+                with self._lock:
+                    self._tracked[rid][id(ticket)] = (
+                        fed, req.verifier, req.deadline, req.cls,
+                        tenant_name)
+                return
+        # Host floor: no peer admitted it (or the request was never
+        # front-door tracked — a direct replica submission the
+        # federation cannot re-point) — decide HERE with the exact
+        # host math and resolve the original ticket.  Zero lost.
+        self.totals["host_floor"] += 1
+        _metrics.record_fault("federation_host_floor")
+        try:
+            # rng=None: blinders come from the default secrets-grade
+            # source — a fixed/derivable coefficient stream here would
+            # let an adversary who forces the fleet to the floor craft
+            # batches whose errors cancel under known coefficients.
+            verdict = _batch._host_verdict(req.verifier, None)
+        except Exception as exc:  # host path failed: explicit evidence
+            req.ticket._fail(exc)
+            return
+        req.ticket._resolve(verdict)
+
+    def _prune_tracked(self, rid: int) -> None:
+        with self._lock:
+            tr = self._tracked.get(rid)
+            if not tr:
+                return
+            done = [k for k, entry in tr.items()
+                    if entry[0] is not None and entry[0].done()]
+            for k in done:
+                del tr[k]
+
+    def pump_replica(self, rid: int) -> int:
+        """Pump ONE replica one dispatcher wave (through the
+        whole-replica fault seam, supervised).  Returns the requests
+        it resolved; 0 for ejected/probation replicas (they receive no
+        production pumps — probes ride `maintain`).  The fleet lab
+        drives replicas individually so its per-replica virtual cost
+        model can account each wave."""
+        rep = self.replicas[rid]
+        state = self.registry.state_of(rid)
+        if state in (_health.REPLICA_EJECTED,
+                     _health.REPLICA_PROBATION):
+            return 0
+        ok, n = self._supervised(
+            rep, lambda svc=rep.service: svc.process_once(block=False))
+        rep.pumps += 1
+        self._prune_tracked(rid)
+        return n if (ok and n) else 0
+
+    def maintain(self) -> None:
+        """The non-wave ladder work: drained-empty replicas eject,
+        probation replicas get their host-verified probes (revival
+        included)."""
+        self._advance_drains()
+        self._run_probes()
+
+    def process_once(self) -> int:
+        """One federation dispatcher iteration: pump every placed (or
+        draining) replica one wave, advance drain→eject transitions,
+        run probation probes.  Returns requests resolved this
+        iteration.  Deterministic under an injected FakeClock — the
+        fleet lab's drive loop."""
+        resolved = 0
+        for rid in sorted(self.replicas):
+            resolved += self.pump_replica(rid)
+        self.maintain()
+        return resolved
+
+    def _advance_drains(self) -> None:
+        for rid in self.registry.draining_replicas():
+            rep = self.replicas[rid]
+            if rep.service.stats()["queue_requests"] == 0:
+                # Drained empty: nothing left to finish — eject (its
+                # surrendered-queue re-issue is a no-op) and start the
+                # probe clock.
+                self._eject(rep, "drain complete")
+
+    def _probe_batch(self, ordinal: int):
+        """(expected verdict, Verifier) for one probation probe —
+        truth known BY CONSTRUCTION (even ordinals valid, odd carry
+        one tampered message), so comparing the replica's verdict to
+        `expected` is a host-grade check without re-running the host
+        path."""
+        from .signing_key import SigningKey
+
+        rnd = random.Random(_faults._stable_seed(
+            self._probe_seed, "replica-probe", ordinal))
+        keys = [SigningKey.new(rnd) for _ in range(2)]
+        want = ordinal % 2 == 0
+        v = _batch.Verifier()
+        for j, sk in enumerate(keys):
+            m = b"replica probe %d %d" % (ordinal, j)
+            sig = sk.sign(m)
+            if not want and j == 1:
+                m += b"!"
+            v.queue((sk.verification_key_bytes(), sig, m))
+        return want, v
+
+    def _run_probes(self) -> None:
+        for rid in sorted(self.registry.probation_replicas()):
+            rep = self.replicas[rid]
+            if rep.crashed:
+                # Revival: a crashed replica's process restarts fresh
+                # through the factory (same namespaced cache object,
+                # already dropped at ejection).  Sweep the OLD service
+                # first — a submission that raced the ejection may
+                # still be queued on it, and replacing the instance
+                # would strand that ticket forever.
+                self._sweep_ejected(rep)
+                rep.service = self._factory(rid, self._clock, rep.cache)
+                rep.crashed = False
+                rep.degraded_frac = None
+                self.totals["revivals"] += 1
+                _metrics.record_fault("replica_revived")
+            self._probe_ord += 1
+            self.totals["probes"] += 1
+            want, v = self._probe_batch(self._probe_ord)
+
+            def _probe(rep=rep, v=v):
+                ticket = rep.service.submit(
+                    v, cls=_tenancy.CLASS_RPC, tenant="_probe")
+                rep.service.process_once(block=False)
+                return ticket.result(0)
+
+            ok, got = self._supervised(rep, _probe)
+            if ok and got == want:
+                if self.registry.record_probe_pass(rid):
+                    self.totals["rejoins"] += 1
+                    _metrics.record_fault("replica_rejoined")
+            else:
+                self.totals["probe_failures"] += 1
+                self.registry.record_probe_fail(
+                    rid, reason="probe verdict mismatch"
+                    if ok else "probe dispatch failed")
+
+    def pump_forever(self, stop_event: "threading.Event") -> None:
+        """Drive `process_once` until `stop_event` is set — the
+        embedding's dedicated dispatcher thread (the deterministic
+        labs call `process_once` directly instead)."""
+        while not stop_event.is_set():
+            if self.process_once() == 0:
+                stop_event.wait(0.005)
+
+    # -- observability + lifecycle ----------------------------------------
+
+    def affinity_hit_rate(self) -> "float | None":
+        s = self.totals["submitted"] - self.totals["rejected_overloaded"]
+        return self.totals["affinity_hits"] / s if s > 0 else None
+
+    def stats(self) -> dict:
+        """Fleet snapshot: per-replica state/capacity/queues, the
+        ladder ledger, affinity accounting, and the lifetime totals."""
+        per = {}
+        for rid in sorted(self.replicas):
+            rep = self.replicas[rid]
+            st = rep.service.stats()
+            per[rid] = {
+                "state": self.registry.state_of(rid),
+                "suspicion": round(self.registry.suspicion(rid), 4),
+                "capacity_fraction": round(rep.capacity_fraction(), 4),
+                "queue_requests": st["queue_requests"],
+                "queue_sigs": st["queue_sigs"],
+                "submitted": st["submitted"],
+                "resolved": st["resolved"],
+                "breaker_state": st["breaker_state"],
+                "devcache": {
+                    "namespace": rep.cache.namespace,
+                    "resident_keysets": rep.cache.resident_count(),
+                },
+                "crashed": rep.crashed,
+                "pumps": rep.pumps,
+            }
+        return {
+            "replicas": per,
+            "replica_states": self.registry.replica_states(),
+            "affinity_hit_rate": self.affinity_hit_rate(),
+            "error_classes": dict(self.error_classes),
+            **self.totals,
+        }
+
+    def close(self) -> None:
+        """Stop admitting fleet-wide and drain every live replica
+        (every pending request still resolves — zero lost)."""
+        with self._lock:
+            self._closed = True
+        for rid in sorted(self.replicas):
+            rep = self.replicas[rid]
+            state = self.registry.state_of(rid)
+            if rep.crashed or state in (_health.REPLICA_EJECTED,
+                                        _health.REPLICA_PROBATION):
+                # Not pumpable: anything a racing submit left queued
+                # re-issues on live peers (or the host floor) instead
+                # of dying with the instance.
+                self._sweep_ejected(rep)
+                continue
+            rep.service.close(drain=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

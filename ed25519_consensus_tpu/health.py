@@ -54,6 +54,11 @@ __all__ = [
     "ErrorVerdict", "classify_device_error",
     "STATE_HEALTHY", "STATE_SUSPECTED", "STATE_QUARANTINED",
     "STATE_PROBATION", "SENTINEL_SUSPICION", "AMBIGUOUS_SUSPICION",
+    "ReplicaRegistry",
+    "REPLICA_HEALTHY", "REPLICA_SUSPECT", "REPLICA_DRAINING",
+    "REPLICA_EJECTED", "REPLICA_PROBATION",
+    "REPLICA_FATAL_SUSPICION", "REPLICA_TRANSIENT_SUSPICION",
+    "REPLICA_AMBIGUOUS_SUSPICION",
 ]
 
 
@@ -639,6 +644,267 @@ def chip_registry() -> ChipRegistry:
     """The process ChipRegistry (chip liveness for the reformation
     ladder — routing.reform_for and the scheduler consult this)."""
     return _chip_registry
+
+
+# -- replica registry (round 11, federation) -------------------------------
+#
+# The suspicion/quarantine idiom one level UP: where ChipRegistry tracks
+# physical chips inside one mesh, ReplicaRegistry tracks whole replica
+# services inside a federation (federation.ReplicaSet).  The ladder is
+# deliberately one rung richer than the chip one — a replica has queued
+# work a chip does not, so between "suspect" and "gone" there is a
+# DRAIN rung where the replica finishes what it holds while receiving
+# nothing new:
+#
+#   suspect → drain → eject → probe → rejoin
+#
+# * SUSPECT    — decayed suspicion > 0 (transient/ambiguous evidence,
+#   health.classify_device_error at replica granularity): still fully
+#   placed, the ledger is just warm.
+# * DRAINING   — suspicion crossed the threshold: the affinity router
+#   stops handing the replica NEW work; queued/in-flight work finishes
+#   normally (its verdicts were never in question — the ladder gates
+#   placement, not math).  The federation layer ejects once the queue
+#   empties.
+# * EJECTED    — no traffic at all (a crash/fatal error lands here
+#   directly, skipping drain — there is nothing left to finish); the
+#   federation layer re-issues the replica's surrendered work on peers
+#   with fresh blinders, never verdict transfer.
+# * PROBATION  — read-side relaxation once suspicion decays below half
+#   the threshold (the ChipRegistry hysteresis, verbatim): the replica
+#   is probed with host-verified batches; ED25519_TPU_REPLICA_PROBES
+#   consecutive clean probes REJOIN it (state cleared, the affinity
+#   ring reforms over it on the next read), any failure re-ejects with
+#   suspicion pinned at the threshold.
+#
+# NOT process-global: a ReplicaRegistry belongs to its ReplicaSet
+# (injectable, like DeviceOperandCache), so two federations in one
+# process — or a test and the code under test — never share ledgers.
+
+REPLICA_HEALTHY = "healthy"
+REPLICA_SUSPECT = "suspect"
+REPLICA_DRAINING = "draining"
+REPLICA_EJECTED = "ejected"
+REPLICA_PROBATION = "probation"
+
+# Evidence weights, mirroring the chip ladder's reasoning: a FATAL
+# classification (crash, mesh-wide wedge the classifier attributes) is
+# conclusive; a transient error is one strike of a pattern; ambiguity
+# is smeared weak evidence.
+REPLICA_FATAL_SUSPICION = 10.0
+REPLICA_TRANSIENT_SUSPICION = 1.0
+REPLICA_AMBIGUOUS_SUSPICION = 0.5
+
+
+class ReplicaRegistry:
+    """Suspicion ledger + escalation ladder for WHOLE REPLICAS (module
+    comment above).  Same thread contract as ChipRegistry: every field
+    under the lock, no call-outs while holding it, all timestamps from
+    the injected clock.  Suspicion and states gate the federation
+    router's PLACEMENT only — no verdict path reads them
+    (docs/consensus-invariants.md, federation section)."""
+
+    def __init__(self, clock: "Clock | None" = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        # rid -> [score, stamp] (decayed lazily, the ChipRegistry
+        # idiom); rid -> DRAINING | EJECTED | PROBATION (absent =
+        # healthy or merely suspect); rid -> consecutive clean probes.
+        self._suspicion = {}
+        self._state = {}
+        self._probe_passes = {}
+
+    @staticmethod
+    def _threshold() -> float:
+        return _config.get("ED25519_TPU_REPLICA_SUSPICION_THRESHOLD")
+
+    @staticmethod
+    def _half_life() -> float:
+        return _config.get("ED25519_TPU_REPLICA_SUSPICION_HALF_LIFE")
+
+    @staticmethod
+    def _probes_needed() -> int:
+        return _config.get("ED25519_TPU_REPLICA_PROBES")
+
+    def set_clock(self, clock: "Clock | None") -> None:
+        with self._lock:
+            self.clock = clock if clock is not None else SYSTEM_CLOCK
+
+    def _decayed_locked(self, rid: int, now: float) -> float:
+        rec = self._suspicion.get(rid)
+        if rec is None:
+            return 0.0
+        score, stamp = rec
+        hl = self._half_life()
+        if hl > 0 and now > stamp:
+            score *= 0.5 ** ((now - stamp) / hl)
+        rec[0], rec[1] = score, now
+        if score < 1e-6:
+            del self._suspicion[rid]
+            return 0.0
+        return score
+
+    def _relax_locked(self, now: float) -> None:
+        """Read-side eject → probation relaxation: suspicion decayed
+        below HALF the threshold (hysteresis — re-eject needs fresh
+        evidence, not clock jitter)."""
+        half = self._threshold() * 0.5
+        for r, st in list(self._state.items()):
+            if st == REPLICA_EJECTED \
+                    and self._decayed_locked(r, now) <= half:
+                self._state[r] = REPLICA_PROBATION
+                self._probe_passes[r] = 0
+
+    def suspicion(self, rid: int) -> float:
+        with self._lock:
+            return self._decayed_locked(int(rid), self.clock.monotonic())
+
+    def record_suspicion(self, rid: int, weight: float,
+                         reason: str = "suspicion") -> str:
+        """Land one piece of evidence against replica `rid`; crossing
+        the threshold moves a placed replica to DRAINING (never
+        straight to ejected — its queue still holds admitted work the
+        zero-lost contract owes a resolution).  Returns the resulting
+        state."""
+        rid = int(rid)
+        with self._lock:
+            now = self.clock.monotonic()
+            score = self._decayed_locked(rid, now) + float(weight)
+            self._suspicion[rid] = [score, now]
+            st = self._state.get(rid)
+            if score >= self._threshold() and st is None:
+                self._state[rid] = REPLICA_DRAINING
+                st = REPLICA_DRAINING
+            return st if st is not None else (
+                REPLICA_SUSPECT if score > 0 else REPLICA_HEALTHY)
+
+    def mark_draining(self, rid: int,
+                      reason: str = "operator-drain") -> None:
+        """Explicitly start draining a replica (operator action, or the
+        federation router reacting to classified evidence) without
+        waiting for the suspicion threshold."""
+        with self._lock:
+            if self._state.get(int(rid)) is None:
+                self._state[int(rid)] = REPLICA_DRAINING
+
+    def mark_ejected(self, rid: int,
+                     reason: str = "replica-ejected") -> None:
+        """Eject a replica NOW (drain completed, or a crash/fatal error
+        where there is nothing to drain): no traffic until it probes
+        back in.  Suspicion pins at the threshold so probation
+        eligibility waits out a full decay period."""
+        rid = int(rid)
+        with self._lock:
+            now = self.clock.monotonic()
+            score = max(self._decayed_locked(rid, now),
+                        self._threshold())
+            self._suspicion[rid] = [score, now]
+            self._state[rid] = REPLICA_EJECTED
+            self._probe_passes.pop(rid, None)
+
+    def state_of(self, rid: int) -> str:
+        with self._lock:
+            now = self.clock.monotonic()
+            self._relax_locked(now)
+            st = self._state.get(int(rid))
+            if st is not None:
+                return st
+            return (REPLICA_SUSPECT
+                    if self._decayed_locked(int(rid), now) > 0
+                    else REPLICA_HEALTHY)
+
+    def accepting(self, rid: int) -> bool:
+        """May the affinity router hand replica `rid` NEW work?
+        Healthy and suspect accept; draining/ejected/probation do not
+        (the ladder's whole point)."""
+        return self.state_of(rid) in (REPLICA_HEALTHY, REPLICA_SUSPECT)
+
+    def placeable(self, replica_ids) -> "tuple[int, ...]":
+        """The subset of `replica_ids` currently accepting new work,
+        in the given order.  Reading applies the read-side transitions
+        (decay, eject → probation)."""
+        return tuple(r for r in replica_ids if self.accepting(r))
+
+    def draining_replicas(self) -> "frozenset[int]":
+        with self._lock:
+            self._relax_locked(self.clock.monotonic())
+            return frozenset(r for r, st in self._state.items()
+                             if st == REPLICA_DRAINING)
+
+    def ejected_replicas(self) -> "frozenset[int]":
+        with self._lock:
+            self._relax_locked(self.clock.monotonic())
+            return frozenset(r for r, st in self._state.items()
+                             if st == REPLICA_EJECTED)
+
+    def probation_replicas(self) -> "frozenset[int]":
+        with self._lock:
+            self._relax_locked(self.clock.monotonic())
+            return frozenset(r for r, st in self._state.items()
+                             if st == REPLICA_PROBATION)
+
+    def record_probe_pass(self, rid: int) -> bool:
+        """One clean HOST-VERIFIED probe batch; True when the replica
+        completed probation and REJOINED (state and suspicion cleared
+        — the next affinity read places it again)."""
+        rid = int(rid)
+        with self._lock:
+            self._relax_locked(self.clock.monotonic())
+            if self._state.get(rid) != REPLICA_PROBATION:
+                return False
+            n = self._probe_passes.get(rid, 0) + 1
+            if n >= self._probes_needed():
+                del self._state[rid]
+                self._probe_passes.pop(rid, None)
+                self._suspicion.pop(rid, None)
+                return True
+            self._probe_passes[rid] = n
+            return False
+
+    def record_probe_fail(self, rid: int,
+                          reason: str = "probe-failed") -> None:
+        """A probation probe diverged from the host oracle (or the
+        probe errored): straight back to EJECTED with suspicion pinned
+        — an oscillating replica cannot walk back in."""
+        rid = int(rid)
+        with self._lock:
+            now = self.clock.monotonic()
+            score = max(self._decayed_locked(rid, now)
+                        + REPLICA_FATAL_SUSPICION, self._threshold())
+            self._suspicion[rid] = [score, now]
+            self._state[rid] = REPLICA_EJECTED
+            self._probe_passes.pop(rid, None)
+
+    def replica_states(self) -> "dict[int, dict]":
+        """Observability snapshot: {rid: {state, suspicion,
+        probe_passes}} for every replica with ledger state."""
+        with self._lock:
+            now = self.clock.monotonic()
+            self._relax_locked(now)
+            rids = set(self._state) | set(self._suspicion)
+            return {
+                r: {
+                    "state": self._state.get(
+                        r, REPLICA_SUSPECT
+                        if self._decayed_locked(r, now) > 0
+                        else REPLICA_HEALTHY),
+                    "suspicion": round(self._decayed_locked(r, now), 4),
+                    "probe_passes": self._probe_passes.get(r, 0),
+                }
+                for r in sorted(rids)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._suspicion.clear()
+            self._state.clear()
+            self._probe_passes.clear()
+            self.clock = SYSTEM_CLOCK
+
+    def __repr__(self):
+        with self._lock:
+            return (f"ReplicaRegistry("
+                    f"states={dict(sorted(self._state.items()))})")
 
 
 class DeviceHealth:

@@ -47,6 +47,7 @@ Env knobs (config surface, SURVEY.md §5):
   effect; a COLD cache always reproduces the unscaled r5 model.
 """
 
+import hashlib
 import threading
 
 from . import config as _config
@@ -56,6 +57,7 @@ __all__ = [
     "RoutingPolicy", "default_policy", "set_default_policy",
     "available_devices", "healthy_device_count", "reform_for",
     "estimate_device_terms",
+    "replica_affinity_order", "replica_for",
 ]
 
 # r5 scaling-lab constants (BASELINE.md mesh section): tunneled per-call
@@ -145,6 +147,59 @@ def reform_for(width: "int | None" = None
     if ids == tuple(range(rung)):
         ids = None
     return rung, ids
+
+
+def replica_affinity_order(keyset_digest: "bytes | None", tenant: str,
+                           replica_ids) -> "tuple[int, ...]":
+    """Replica selection AHEAD of the mesh N* model (ROADMAP item 4):
+    the federation layer's consistent-hash keyset/tenant → replica
+    affinity, as rendezvous (highest-random-weight) hashing.
+
+    Returns `replica_ids` sorted by descending SHA-256 score of
+    (digest, tenant, replica id) — a PURE function of its inputs, with
+    the rendezvous minimal-disruption property: removing a replica
+    moves only the keys whose FIRST choice it was (each to its
+    second choice — the deterministic spillover target), and adding
+    one moves only the keys that now score highest on the newcomer.
+    Keyset residency therefore stays hot per replica across membership
+    changes, which is the whole point of affinity.
+
+    The order — not just the winner — is the spillover policy: a
+    degraded/overloaded first choice hands the submission to the NEXT
+    replica in this same order, so one keyset's spillover traffic
+    lands on one deterministic peer (and warms exactly one peer's
+    cache) instead of spraying the fleet.  `keyset_digest` None (a
+    batch with no canonical keyset blob) hashes as the empty digest —
+    still deterministic, still tenant-spread.
+
+    Replica choice is PLACEMENT, never math: whichever replica wins,
+    the verdict comes from that replica's verify_many ladder
+    (docs/consensus-invariants.md, "why federation cannot affect
+    verdicts")."""
+    digest = keyset_digest if keyset_digest is not None else b""
+
+    def score(rid: int) -> "tuple":
+        h = hashlib.sha256(
+            digest + repr(("replica-affinity", tenant, int(rid))).encode()
+        ).digest()
+        # Descending score; replica id breaks (cryptographically
+        # improbable) ties so the order is total and reproducible.
+        return (h, int(rid))
+
+    return tuple(sorted((int(r) for r in replica_ids),
+                        key=score, reverse=True))
+
+
+def replica_for(keyset_digest: "bytes | None", tenant: str,
+                replica_count: int) -> int:
+    """The affinity winner among replicas [0, replica_count): a pure
+    function of (keyset digest, tenant, replica count) — the
+    deterministic-assignment property tests/test_federation.py pins
+    with committed fixtures."""
+    if replica_count <= 0:
+        raise ValueError("replica_count must be positive")
+    return replica_affinity_order(
+        keyset_digest, tenant, range(int(replica_count)))[0]
 
 
 def estimate_device_terms(verifier) -> int:

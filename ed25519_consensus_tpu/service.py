@@ -295,6 +295,11 @@ class VerifyService:
       budget is below the current estimate routes host-side.
     * auto_start — start the dispatcher thread; pass False for
       deterministic single-threaded tests driving `process_once()`.
+    * replica_id / cache — federation hooks (round 11): the replica
+      identity this service serves under (stats/observability) and an
+      injected per-replica DeviceOperandCache for tenant assignment
+      (a ReplicaSet namespaces residency per replica).  Both are
+      placement state, never verdict inputs.
 
     Thread semantics: `submit` is callable from any number of threads;
     one dispatcher (thread or `process_once` caller) executes waves —
@@ -317,7 +322,9 @@ class VerifyService:
                  breaker_failure_threshold: int = 2,
                  breaker_seed: int = 0,
                  device_time_prior: float = 2.0,
-                 rng=None, auto_start: bool = True):
+                 rng=None, auto_start: bool = True,
+                 replica_id: "str | None" = None,
+                 cache=None):
         # Per-class admission policy (tenancy.py): mempool keeps the
         # (high, low) watermark pair — the exact pre-tenancy admission
         # semantics and the class `submit()` defaults to — rpc sheds
@@ -343,6 +350,15 @@ class VerifyService:
         self._device_estimate = float(device_time_prior)
         self._rng = rng
         self._host_health = _HostOnlyHealth(self._clock)
+        # Federation (round 11): the replica identity this service
+        # serves under (None = a standalone, un-federated service) and
+        # the injected device-operand-cache instance its tenant
+        # assignments land in (None = the process default cache).  A
+        # ReplicaSet gives each replica its own NAMESPACED cache so
+        # keyset affinity keeps residency hot per replica — both are
+        # placement/observability state, never verdict inputs.
+        self.replica_id = replica_id
+        self.cache = cache
 
         self._cv = threading.Condition()
         # One FIFO queue per traffic class, drained in CLASSES priority
@@ -367,6 +383,12 @@ class VerifyService:
             # Device waves dispatched on a reformed (degraded) mesh
             # shape instead of the configured one (round 9).
             "degraded_waves": 0,
+            # Intra-wave dedup (round 11, ROADMAP item 5 first slice):
+            # requests whose verdict was decided by an IDENTICAL
+            # concurrent submission in the same wave and fanned out —
+            # the mempool→consensus double-verify collapsing inside
+            # one dispatcher wave.
+            "dedup_fanout": 0,
         }
         # Per-class lifecycle tallies (the fairness surface the traffic
         # lab and the SLO gates read): every submission lands in
@@ -537,7 +559,8 @@ class VerifyService:
         optimization hint, never correctness state."""
         from . import devcache as _devcache
 
-        cache = _devcache.default_cache()
+        cache = (self.cache if self.cache is not None
+                 else _devcache.default_cache())
         if not cache.enabled:
             return
         blob = verifier._canonical_keyset_blob()
@@ -658,8 +681,33 @@ class VerifyService:
         """Run one routed group through verify_many under supervision:
         whatever happens — device sickness, injected storms, even an
         exception escaping the scheduler — every ticket resolves, and
-        verdicts only ever come from ladder-decided math."""
-        vs = [r.verifier for r in reqs]
+        verdicts only ever come from ladder-decided math.
+
+        INTRA-WAVE DEDUP (round 11, the first slice of ROADMAP item
+        5): real consensus nodes verify the same (sig, key, msg) set
+        more than once — mempool admission, then the proposed block —
+        and under load those duplicates land in the SAME dispatcher
+        wave.  Identical concurrent submissions (byte-identical queue
+        streams, `Verifier.content_digest()`) are decided ONCE and the
+        verdict fanned out to every waiter: bit-identical by
+        construction, since all waiters receive the single
+        ladder-decided bool — dedup chooses how often the work runs,
+        never what the answer is.  Batches without a live content
+        digest (exposed coalescing map, out-of-band invalidation)
+        never dedup — full verification is always the safe default."""
+        reps, rep_of, seen = [], [], {}
+        for r in reqs:
+            d = r.verifier.content_digest()
+            if d is not None and d in seen:
+                rep_of.append(seen[d])
+                self.totals["dedup_fanout"] += 1
+                _metrics.record_fault("service_dedup_fanout")
+                continue
+            if d is not None:
+                seen[d] = len(reps)
+            rep_of.append(len(reps))
+            reps.append(r.verifier)
+        vs = reps
         try:
             if device:
                 # Device waves dispatch the REFORMED mesh shape, not
@@ -714,7 +762,8 @@ class VerifyService:
                     verdicts.append(_batch._host_verdict(v, self._rng))
                 except Exception as exc:  # host path itself failed: the
                     verdicts.append(exc)  # ticket carries the evidence
-        for req, verdict in zip(reqs, verdicts):
+        for req, ri in zip(reqs, rep_of):
+            verdict = verdicts[ri]
             if isinstance(verdict, Exception):
                 req.ticket._fail(verdict)
             else:
@@ -765,12 +814,37 @@ class VerifyService:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def surrender_pending(self) -> "list[_Request]":
+        """FEDERATION takeover (round 11): remove and return every
+        still-QUEUED request — tickets untouched, nothing failed — so
+        a ReplicaSet ejecting this replica can re-issue the admitted
+        work on a healthy peer.  The zero-lost contract transfers with
+        the requests: the caller now owes each ticket a resolution
+        (re-submission re-VERIFIES on the peer with fresh blinders —
+        re-issue is re-verification, never verdict transfer; see
+        docs/consensus-invariants.md, federation section).  Requests
+        already handed to a wave are not here — they resolve (or
+        crash-fallback) through the normal `_execute` supervision.
+        The service keeps admitting unless also closed; an ejected
+        replica's front door is closed by its ReplicaSet."""
+        out = []
+        with self._cv:
+            for q in self._queues.values():
+                out.extend(q)
+                q.clear()
+            self._queue_sigs = 0
+            for cls in self.class_policies:
+                self._set_shedding(cls, False)
+            self._update_gauges()
+        return out
+
     def stats(self) -> dict:
         """Snapshot: queue depth, admission state, breaker state, the
         lifetime totals, and the per-class fairness rows."""
         with self._cv:
             reg = _health.chip_registry()
             return {
+                "replica_id": self.replica_id,
                 "queue_sigs": self._queue_sigs,
                 "effective_capacity_sigs": self.effective_capacity_sigs(),
                 # Round 10 observability: the diagnosed chip ledger an

@@ -45,7 +45,7 @@ __all__ = [
     "CLASS_CONSENSUS", "CLASS_MEMPOOL", "CLASS_RPC", "CLASSES",
     "DEFAULT_TENANT", "class_rank", "ClassPolicy", "class_policies",
     "poisson_arrivals", "burst_arrivals", "diurnal_arrivals",
-    "arrivals", "TrafficStream", "default_matrix",
+    "arrivals", "TrafficStream", "default_matrix", "fleet_matrix",
 ]
 
 # Priority order, highest first: the dispatcher drains waves in this
@@ -283,6 +283,44 @@ class TrafficStream:
         return (f"TrafficStream({self.tenant!r}, {self.cls!r}, "
                 f"{self.kind!r}, fraction={self.fraction}, "
                 f"deadline_s={self.deadline_s}, sigs={self.sigs})")
+
+
+def fleet_matrix(chains: int, zipf_s: float = 0.8
+                 ) -> "tuple[TrafficStream, ...]":
+    """The FLEET-scale traffic matrix (ROADMAP item 4): `chains`
+    tenants, each a chain with steady consensus traffic (tight
+    deadline), mempool gossip (alternating poisson/diurnal shapes),
+    and rpc edge traffic (alternating poisson/burst) — three streams
+    per chain, fractions summing to 1 so the offered load stays
+    exactly the lab's `--load` knob whatever the chain count.
+
+    Chain weights are zipf-skewed (weight ∝ 1/(rank+1)^`zipf_s`) —
+    the N ≫ 2 tenants follow-up: a few heavy chains dominate, a long
+    tail barely registers, which is both what real multichain traffic
+    looks like and what stresses the federation's affinity balance
+    (the heavy chain's home replica runs hotter than the fleet
+    average).  A pure function of (chains, zipf_s) — no seed: the
+    matrix is structure, the arrival processes carry the randomness."""
+    if chains < 1:
+        raise ValueError("need at least one chain")
+    weights = [1.0 / (c + 1) ** float(zipf_s) for c in range(chains)]
+    total = sum(weights)
+    mem_kinds = ("poisson", "diurnal")
+    rpc_kinds = ("poisson", "burst")
+    streams = []
+    for c in range(chains):
+        share = weights[c] / total
+        t = f"chain-{c:03d}"
+        streams.append(TrafficStream(
+            t, CLASS_CONSENSUS, "poisson",
+            fraction=share * 0.35, deadline_s=2.0))
+        streams.append(TrafficStream(
+            t, CLASS_MEMPOOL, mem_kinds[c % 2],
+            fraction=share * 0.40, deadline_s=8.0))
+        streams.append(TrafficStream(
+            t, CLASS_RPC, rpc_kinds[c % 2],
+            fraction=share * 0.25, deadline_s=None))
+    return tuple(streams)
 
 
 def default_matrix() -> "tuple[TrafficStream, ...]":

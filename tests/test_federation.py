@@ -1,0 +1,523 @@
+"""Federated replica meshes (round 11): consistent-hash affinity,
+the replica escalation ladder (suspect → drain → eject → probe →
+rejoin), whole-replica failover with work re-issue, affinity-
+preserving spillover, and per-replica devcache namespaces.
+
+The property under test is the ISSUE-13 claim: replica loss degrades
+CAPACITY, never verdicts — every verdict is decided by some replica's
+verify_many ladder or the exact host floor, placement machinery only
+ever chooses WHO decides.  tools/traffic_lab.py --fleet drives the
+full 50-chain chaos run in CI; everything here is the deterministic
+FakeClock test scale."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ed25519_consensus_tpu import (
+    SigningKey,
+    batch,
+    devcache,
+    faults,
+    federation,
+    health,
+    routing,
+    service,
+    tenancy,
+)
+
+rng = random.Random(0xFED5)
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    yield
+    if faults.active_plan():
+        faults.uninstall()
+    devcache.set_default_cache(None)
+    batch.reset_device_health()
+
+
+_KEYS = {t: [SigningKey.new(rng) for _ in range(3)]
+         for t in ("chain-a", "chain-b", "chain-c")}
+
+
+def make_verifier(tenant, i, bad=False):
+    v = batch.Verifier()
+    for j, sk in enumerate(_KEYS[tenant]):
+        m = b"fed %s %d %d" % (tenant.encode(), i, j)
+        sig = sk.sign(m)
+        if bad and j == 1:
+            m += b"!"
+        v.queue((sk.verification_key_bytes(), sig, m))
+    return v
+
+
+def host_factory(capacity=4096):
+    """Host-modelled replica services on the shared fleet clock: the
+    federation machinery is under test, not the device lane."""
+
+    def factory(rid, clock, cache):
+        return service.VerifyService(
+            capacity_sigs=capacity, clock=clock, auto_start=False,
+            replica_id=f"r{rid}", cache=cache, mesh=0,
+            health=service._HostOnlyHealth(clock),
+            rng=random.Random(rid))
+
+    return factory
+
+
+def make_set(replicas=3, capacity=4096, **kw):
+    clock = health.FakeClock()
+    fs = federation.ReplicaSet(
+        replicas, service_factory=host_factory(capacity), clock=clock,
+        capacity_sigs=capacity, **kw)
+    return fs, clock
+
+
+def drain(fs, rounds=50):
+    for _ in range(rounds):
+        if fs.process_once() == 0:
+            break
+
+
+# -- consistent-hash affinity (pure functions) -----------------------------
+
+def test_affinity_order_is_a_pure_deterministic_function():
+    d = b"\x01" * 32
+    o1 = routing.replica_affinity_order(d, "chain-a", range(5))
+    o2 = routing.replica_affinity_order(d, "chain-a", range(5))
+    assert o1 == o2 and sorted(o1) == [0, 1, 2, 3, 4]
+    # tenant and digest both matter
+    assert o1 != routing.replica_affinity_order(d, "chain-b", range(5)) \
+        or o1 != routing.replica_affinity_order(
+            b"\x02" * 32, "chain-a", range(5))
+    # None digest is deterministic too
+    assert routing.replica_affinity_order(None, "t", range(3)) == \
+        routing.replica_affinity_order(None, "t", range(3))
+
+
+def test_replica_for_pinned_fixture():
+    """COMMITTED assignment fixture: a pure function of (keyset
+    digest, tenant, replica count) — if this pin moves, every
+    deployed federation's residency goes cold on upgrade, which is a
+    reviewed act, not an accident."""
+    import hashlib
+
+    digests = [hashlib.sha256(b"keyset-%d" % i).digest()
+               for i in range(6)]
+    got3 = [routing.replica_for(d, "chain-a", 3) for d in digests]
+    got4 = [routing.replica_for(d, "chain-a", 4) for d in digests]
+    assert got3 == [0, 0, 1, 2, 2, 1]
+    assert got4 == [0, 0, 1, 2, 3, 1]
+
+
+def test_affinity_minimal_disruption_on_add_and_remove():
+    """The rendezvous property the consistent hash is FOR: growing
+    M→M+1 moves ONLY the keys the new replica wins; removing a
+    replica moves ONLY its keys, each to its previous second
+    choice."""
+    import hashlib
+
+    digests = [hashlib.sha256(b"d%d" % i).digest() for i in range(200)]
+    for d in digests:
+        o3 = routing.replica_affinity_order(d, "t", range(3))
+        o4 = routing.replica_affinity_order(d, "t", range(4))
+        if o4[0] != 3:
+            assert o4[0] == o3[0]  # add moves only the newcomer's keys
+        # removal of the winner: the key lands exactly on its second
+        # choice (spillover target = failover target, by construction)
+        survivors = [r for r in range(3) if r != o3[0]]
+        o_removed = routing.replica_affinity_order(d, "t", survivors)
+        assert o_removed[0] == o3[1]
+        # removal of a non-winner never moves this key
+        others = [r for r in range(3) if r != o3[2]]
+        assert routing.replica_affinity_order(d, "t", others)[0] == o3[0]
+
+
+# -- the replica registry ladder -------------------------------------------
+
+def test_replica_suspicion_accumulates_decays_and_drains():
+    clock = health.FakeClock()
+    reg = health.ReplicaRegistry(clock=clock)
+    assert reg.state_of(1) == health.REPLICA_HEALTHY
+    reg.record_suspicion(1, 1.0, "wedge")
+    assert reg.state_of(1) == health.REPLICA_SUSPECT
+    assert reg.accepting(1)
+    # decay: one half-life halves the score
+    clock.advance(300.0)
+    assert abs(reg.suspicion(1) - 0.5) < 1e-6
+    # accumulate past the threshold → DRAINING (not ejected: queued
+    # work still finishes)
+    st = None
+    for _ in range(4):
+        st = reg.record_suspicion(1, 1.0, "wedge")
+    assert st == health.REPLICA_DRAINING
+    assert not reg.accepting(1)
+    assert reg.draining_replicas() == frozenset({1})
+
+
+def test_replica_eject_relaxes_to_probation_then_rejoins():
+    clock = health.FakeClock()
+    reg = health.ReplicaRegistry(clock=clock)
+    reg.mark_ejected(0, "crash")
+    assert reg.state_of(0) == health.REPLICA_EJECTED
+    assert reg.suspicion(0) >= 3.0  # pinned at the threshold
+    # decay below half the threshold → probation (read-side)
+    clock.advance(600.0 + 1.0)
+    assert reg.state_of(0) == health.REPLICA_PROBATION
+    assert not reg.accepting(0)
+    # ED25519_TPU_REPLICA_PROBES=2 clean probes rejoin
+    assert reg.record_probe_pass(0) is False
+    assert reg.record_probe_pass(0) is True
+    assert reg.state_of(0) == health.REPLICA_HEALTHY
+    assert reg.suspicion(0) == 0.0
+
+
+def test_replica_probe_fail_reejects_with_pinned_suspicion():
+    clock = health.FakeClock()
+    reg = health.ReplicaRegistry(clock=clock)
+    reg.mark_ejected(2, "crash")
+    clock.advance(601.0)
+    assert reg.state_of(2) == health.REPLICA_PROBATION
+    reg.record_probe_pass(2)
+    reg.record_probe_fail(2, "verdict mismatch")
+    assert reg.state_of(2) == health.REPLICA_EJECTED
+    assert reg.suspicion(2) >= 3.0
+    # the pass streak reset: after the next probation window a single
+    # pass is not enough
+    clock.advance(601.0)
+    assert reg.record_probe_pass(2) is False
+
+
+def test_replica_registry_placeable_and_snapshot():
+    clock = health.FakeClock()
+    reg = health.ReplicaRegistry(clock=clock)
+    reg.mark_draining(1)
+    reg.mark_ejected(2, "crash")
+    assert reg.placeable(range(4)) == (0, 3)
+    snap = reg.replica_states()
+    assert snap[1]["state"] == health.REPLICA_DRAINING
+    assert snap[2]["state"] == health.REPLICA_EJECTED
+    reg.reset()
+    assert reg.placeable(range(4)) == (0, 1, 2, 3)
+
+
+# -- ReplicaSet routing + verdicts -----------------------------------------
+
+def test_submissions_land_on_affinity_home_and_verdicts_match():
+    fs, clock = make_set()
+    feds = []
+    for i in range(12):
+        tenant = ("chain-a", "chain-b", "chain-c")[i % 3]
+        bad = i % 4 == 0
+        f = fs.submit(make_verifier(tenant, i, bad), cls="consensus",
+                      tenant=tenant)
+        feds.append((f, tenant, not bad))
+    drain(fs)
+    homes = {}
+    for f, tenant, want in feds:
+        assert f.result(5) == want
+        homes.setdefault(tenant, set()).add(f.replica_id)
+    # one stable home per tenant keyset (affinity), all hits
+    assert all(len(rids) == 1 for rids in homes.values())
+    assert fs.affinity_hit_rate() == 1.0
+    assert fs.totals["spillovers"] == 0
+    fs.close()
+
+
+def test_tenant_assignment_lands_in_the_replica_namespaced_cache():
+    fs, clock = make_set()
+    f = fs.submit(make_verifier("chain-a", 0), tenant="chain-a")
+    home = f.replica_id
+    v = make_verifier("chain-a", 1)
+    digest = devcache.keyset_digest(v._canonical_keyset_blob())
+    assert fs.replicas[home].cache.tenant_of(digest) == "chain-a"
+    assert fs.replicas[home].cache.namespace == f"r{home}"
+    for rid, rep in fs.replicas.items():
+        if rid != home:
+            assert rep.cache.tenant_of(digest) == tenancy.DEFAULT_TENANT
+    drain(fs)
+    fs.close()
+
+
+def test_overload_spills_to_next_replica_in_affinity_order():
+    # Tiny per-replica capacity; don't pump, so the home queue fills.
+    fs, clock = make_set(capacity=12)
+    v0 = make_verifier("chain-a", 0)
+    digest = devcache.keyset_digest(v0._canonical_keyset_blob())
+    order = routing.replica_affinity_order(digest, "chain-a", range(3))
+    feds = [fs.submit(make_verifier("chain-a", i), cls="consensus",
+                      tenant="chain-a") for i in range(6)]
+    landed = [f.replica_id for f in feds]
+    assert landed[:4] == [order[0]] * 4  # 4 × 3 sigs fill capacity 12
+    assert landed[4] == order[1]  # spillover: the SECOND choice, not random
+    assert fs.totals["spillovers"] >= 1
+    drain(fs)
+    assert all(f.result(5) is True for f in feds)
+    fs.close()
+
+
+def test_consensus_admitted_while_any_replica_alive():
+    """rpc saturates fleet-wide (every replica's watermark armed) —
+    consensus-class must still find a queue that admits it."""
+    fs, clock = make_set(capacity=24)
+    # arm rpc shedding everywhere: fill over the 0.5 rpc watermark
+    for rid in range(3):
+        for i in range(4):
+            fs.replicas[rid].service.submit(
+                make_verifier("chain-a", 100 * rid + i), cls="mempool")
+    with pytest.raises(service.Overloaded):
+        fs.submit(make_verifier("chain-a", 999), cls="rpc",
+                  tenant="chain-a")
+    fed = fs.submit(make_verifier("chain-a", 1000), cls="consensus",
+                    tenant="chain-a")
+    drain(fs)
+    assert fed.result(5) is True
+    fs.close()
+
+
+def test_split_capacity_spills_lower_classes_keeps_consensus():
+    fs, clock = make_set()
+    v = make_verifier("chain-b", 0)
+    digest = devcache.keyset_digest(v._canonical_keyset_blob())
+    order = routing.replica_affinity_order(digest, "chain-b", range(3))
+    home = order[0]
+    plan = faults.replica_plan(7, "split-capacity", replica=home, at=0,
+                               frac=0.25)
+    with faults.injected(plan):
+        # one pump pass applies the SplitCapacity fault to the home
+        fs.process_once()
+        assert fs.replicas[home].capacity_fraction() == 0.25
+        f_mem = fs.submit(make_verifier("chain-b", 1), cls="mempool",
+                          tenant="chain-b")
+        f_con = fs.submit(make_verifier("chain-b", 2), cls="consensus",
+                          tenant="chain-b")
+        # mempool sheds LOAD to the healthy second choice before
+        # shedding users; consensus keeps its affinity home
+        assert f_mem.replica_id == order[1]
+        assert f_con.replica_id == home
+        assert fs.totals["degraded_spills"] >= 1
+        drain(fs)
+        assert f_mem.result(5) is True and f_con.result(5) is True
+    fs.close()
+
+
+def test_spillover_knob_off_sheds_instead_of_spilling(monkeypatch):
+    monkeypatch.setenv("ED25519_TPU_REPLICA_SPILLOVER", "0")
+    fs, clock = make_set(capacity=12)
+    for i in range(4):
+        fs.submit(make_verifier("chain-a", i), cls="mempool",
+                  tenant="chain-a")
+    # knob off: the full home queue raises instead of trying peers…
+    with pytest.raises(service.Overloaded):
+        fs.submit(make_verifier("chain-a", 9), cls="mempool",
+                  tenant="chain-a")
+    # …but consensus still tries every replica (the guarantee is not
+    # knob-gated)
+    fed = fs.submit(make_verifier("chain-a", 10), cls="consensus",
+                    tenant="chain-a")
+    assert fed.replica_id is not None
+    drain(fs)
+    fs.close()
+
+
+# -- whole-replica failover ------------------------------------------------
+
+def crash_home(fs, tenant):
+    v = make_verifier(tenant, 0)
+    digest = devcache.keyset_digest(v._canonical_keyset_blob())
+    home = routing.replica_affinity_order(digest, tenant, range(3))[0]
+    return home, faults.replica_plan(11, "crash", replica=home, at=0)
+
+
+def test_replica_crash_reissues_queue_zero_lost_host_identical():
+    fs, clock = make_set()
+    home, plan = crash_home(fs, "chain-a")
+    feds = []
+    for i in range(6):
+        bad = i % 3 == 0
+        feds.append((fs.submit(make_verifier("chain-a", i, bad),
+                               cls="consensus", tenant="chain-a"),
+                     not bad))
+    assert all(f.replica_id == home for f, _ in feds)
+    with faults.injected(plan):
+        drain(fs)
+        # every ticket resolved — re-issued on a peer, never lost —
+        # and every verdict matches the construction oracle
+        for f, want in feds:
+            assert f.result(5) == want
+            assert f.replica_id != home  # decided on a peer
+            assert f.replica_trail[0] == home  # audit: placed, moved
+        st = fs.stats()
+        assert st["ejections"] == 1
+        assert st["reissued"] == 6
+        assert st["replicas"][home]["state"] == health.REPLICA_EJECTED
+        assert st["error_classes"]["fatal"] == 1
+    fs.close()
+
+
+def test_crash_drops_the_replica_devcache_namespace():
+    fs, clock = make_set()
+    home, plan = crash_home(fs, "chain-b")
+    cache = fs.replicas[home].cache
+    cache.build(b"\x07" * 32, 3, np.zeros((4, 20, 8), np.int16))
+    assert cache.resident_count() == 1
+    fs.submit(make_verifier("chain-b", 0), tenant="chain-b")
+    with faults.injected(plan):
+        drain(fs)
+    assert cache.resident_count() == 0
+    assert cache.counters["drops"] == 1
+    fs.close()
+
+
+def test_crashed_replica_rejoins_via_host_verified_probes():
+    fs, clock = make_set()
+    home, plan = crash_home(fs, "chain-a")
+    fs.submit(make_verifier("chain-a", 0), cls="consensus",
+              tenant="chain-a")
+    with faults.injected(plan):
+        drain(fs)
+        assert fs.registry.state_of(home) == health.REPLICA_EJECTED
+        # decay (production half-life) → probation → revival + probes
+        clock.advance(601.0)
+        for _ in range(4):  # probes ride maintain(), not the resolve count
+            fs.process_once()
+    st = fs.stats()
+    assert st["revivals"] == 1
+    assert st["rejoins"] == 1
+    assert st["probes"] >= 2
+    assert fs.registry.state_of(home) == health.REPLICA_HEALTHY
+    # the rejoined replica takes new work again
+    f = fs.submit(make_verifier("chain-a", 5), cls="consensus",
+                  tenant="chain-a")
+    assert f.replica_id == home
+    drain(fs)
+    assert f.result(5) is True
+    fs.close()
+
+
+def test_wedge_storm_walks_suspect_drain_eject():
+    fs, clock = make_set()
+    victim = 1
+    plan = faults.replica_plan(3, "wedge", replica=victim, at=0,
+                               length=30, seconds=0.5)
+    with faults.injected(plan):
+        fs.process_once()
+        assert fs.registry.state_of(victim) == health.REPLICA_SUSPECT
+        # transient weight 1.0 per wedge (minus a hair of decay across
+        # the wedge's own clock advances) crosses the 3.0 threshold on
+        # the 4th strike → drain; the queue is empty so the drain
+        # completes into EJECT on the next maintain pass
+        for _ in range(3):
+            fs.process_once()
+        assert fs.registry.state_of(victim) in (
+            health.REPLICA_DRAINING, health.REPLICA_EJECTED)
+        fs.process_once()
+        assert fs.registry.state_of(victim) == health.REPLICA_EJECTED
+        assert fs.error_classes[health.ERROR_TRANSIENT] >= 3
+        # no crash: rejoin probes run against the SAME service (no
+        # revival)
+    clock.advance(601.0)
+    for _ in range(4):  # probes ride maintain(), not the resolve count
+        fs.process_once()
+    st = fs.stats()
+    assert st["rejoins"] == 1 and st["revivals"] == 0
+    fs.close()
+
+
+def test_host_floor_when_no_peer_admits_the_reissue():
+    """2-replica fleet: crash one while the other is FULL — the
+    surrendered work is decided on the exact host path (the fleet
+    zero-lost floor), never dropped."""
+    clock = health.FakeClock()
+    fs = federation.ReplicaSet(
+        2, service_factory=host_factory(9), clock=clock,
+        capacity_sigs=9)
+    a = fs.submit(make_verifier("chain-a", 0), cls="consensus",
+                  tenant="chain-a")
+    victim = a.replica_id
+    other = 1 - victim
+    # fill the peer completely (3 × 3 sigs = its whole capacity)
+    for i in range(3):
+        fs.replicas[other].service.submit(
+            make_verifier("chain-b", i), cls="consensus")
+    plan = faults.replica_plan(5, "crash", replica=victim, at=0)
+    with faults.injected(plan):
+        # pump ONLY the victim: the crash fires while the peer's queue
+        # is still full, so the re-issue has nowhere to go but the
+        # host floor (pumping the peer first would drain it and turn
+        # this into an ordinary re-issue)
+        fs.pump_replica(victim)
+    assert a.result(5) is True
+    assert fs.totals["host_floor"] >= 1
+    drain(fs)
+    fs.close()
+
+
+def test_federated_ticket_trail_and_stats_shape():
+    fs, clock = make_set()
+    f = fs.submit(make_verifier("chain-c", 0), tenant="chain-c")
+    assert f.replica_trail == [f.replica_id]
+    st = fs.stats()
+    assert set(st["replicas"]) == {0, 1, 2}
+    for row in st["replicas"].values():
+        assert row["state"] == health.REPLICA_HEALTHY
+        assert 0.0 < row["capacity_fraction"] <= 1.0
+    assert st["submitted"] == 1
+    drain(fs)
+    assert f.result(5) is True
+    fs.close()
+
+
+def test_surrender_pending_returns_queue_without_failing_tickets():
+    clock = health.FakeClock()
+    svc = service.VerifyService(
+        capacity_sigs=64, clock=clock, auto_start=False, mesh=0,
+        health=service._HostOnlyHealth(clock))
+    tickets = [svc.submit(make_verifier("chain-a", i), cls="consensus")
+               for i in range(3)]
+    reqs = svc.surrender_pending()
+    assert len(reqs) == 3
+    assert svc.stats()["queue_requests"] == 0
+    assert all(not t.done() for t in tickets)
+    # the surrendered requests carry everything a peer re-issue needs
+    assert all(r.verifier.batch_size == 3 and r.cls == "consensus"
+               for r in reqs)
+    # resolving through the surrendered handle reaches the ticket
+    reqs[0].ticket._resolve(True)
+    assert tickets[0].result(0) is True
+    svc.close()
+
+
+def test_racing_submission_onto_ejected_replica_is_swept():
+    """Review hardening: a submission that raced an ejection (its
+    candidate check passed before the eject's surrender sweep ran)
+    lands on a never-pumped service — the sweep re-check re-issues it
+    on a peer instead of stranding the ticket forever, without a
+    second ejection's accounting."""
+    fs, clock = make_set()
+    home, plan = crash_home(fs, "chain-a")
+    with faults.injected(plan):
+        fs.submit(make_verifier("chain-a", 0), cls="consensus",
+                  tenant="chain-a")
+        drain(fs)
+        assert fs.registry.state_of(home) == health.REPLICA_EJECTED
+        ejections_before = fs.totals["ejections"]
+        # emulate the race: enqueue directly onto the ejected
+        # replica's old service with the bridge entry submit() writes
+        rep = fs.replicas[home]
+        v = make_verifier("chain-a", 1)
+        ticket = rep.service.submit(v, cls="consensus",
+                                    tenant="chain-a")
+        fed = federation.FederatedTicket()
+        fed._point_at(ticket, home)
+        fs._tracked[home][id(ticket)] = (fed, v, None, "consensus",
+                                         "chain-a")
+        fs._sweep_ejected(rep)  # what submit()'s re-check invokes
+        drain(fs)
+        assert fed.result(5) is True
+        assert fed.replica_id != home
+        assert fs.totals["ejections"] == ejections_before  # no double
+    fs.close()

@@ -468,3 +468,103 @@ def test_breaker_probe_runs_reformed_mesh_shape(monkeypatch):
         assert seen[-1]["mesh"] == 8
     finally:
         svc.close(drain=False)
+
+
+# -- reformation-rung pre-warm (round 11, ROADMAP item 1(c) follow-up) -----
+
+
+def test_warm_device_shapes_premarks_the_reformation_rung(monkeypatch):
+    """warm_device_shapes(mesh=N) warms the N rung AND the N/2
+    REFORMATION rung and completes both shape keys — the exact keys
+    verify_many's poll consults for the first-compile grace window
+    (`msm.shape_completed(B, lanes, rung)`), so a mid-wave reform
+    immediately after warm-up is held to the NORMAL turnaround
+    deadline, never the minutes-long compile grace.  The sharded
+    dispatch is stubbed by signature (the real-compile variant is the
+    slow test below); the marking contract is what's pinned here."""
+    from ed25519_consensus_tpu.parallel import sharded_msm
+    from ed25519_consensus_tpu.ops import limbs
+
+    calls = []
+
+    def stub(digits, pts, n_devices, clock=None, device_ids=None):
+        calls.append((n_devices, digits.shape))
+        nwin = limbs.NWINDOWS
+        return np.zeros((digits.shape[0], 4, limbs.NLIMBS, nwin),
+                        np.int32)
+
+    monkeypatch.setattr(sharded_msm, "sharded_window_sums_many", stub)
+    # stub the single-device warm too (this test pins the rung MARKING
+    # contract, not kernel compiles — the slow test below compiles)
+    monkeypatch.setattr(
+        msm, "dispatch_window_sums_many",
+        lambda dd, pp: np.zeros((dd.shape[0], 4, 20, 33), np.int32))
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE", "0")
+    v = make_verifiers(1, tag=b"prewarm")[0]
+    n_terms = v.clone()._stage(rng).n_device_terms
+    batch.warm_device_shapes(v, rng=rng, chunk=2, mesh=4)
+    from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+    assert [c[0] for c in calls] == [4, 2]  # width first, then N/2
+    assert msm.shape_completed(2, shard_pad(n_terms, 4), 4)
+    assert msm.shape_completed(2, shard_pad(n_terms, 2), 2)
+    # each rung dispatched at ITS shard pad (rung-specific executable)
+    assert calls[0][1][2] == shard_pad(n_terms, 4)
+    assert calls[1][1][2] == shard_pad(n_terms, 2)
+
+
+def test_warm_device_shapes_mesh_below_two_warms_no_rungs(monkeypatch):
+    from ed25519_consensus_tpu.parallel import sharded_msm
+
+    calls = []
+    monkeypatch.setattr(
+        sharded_msm, "sharded_window_sums_many",
+        lambda *a, **kw: calls.append(a) or np.zeros((2, 4, 20, 33)))
+    monkeypatch.setattr(
+        msm, "dispatch_window_sums_many",
+        lambda dd, pp: np.zeros((dd.shape[0], 4, 20, 33), np.int32))
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE", "0")
+    v = make_verifiers(1, tag=b"prewarm0")[0]
+    batch.warm_device_shapes(v, rng=rng, chunk=2, mesh=1)
+    batch.warm_device_shapes(v, rng=rng, chunk=2)  # historical call shape
+    assert calls == []
+
+
+@pytest.mark.slow
+def test_reform_immediately_after_warmup_dispatches_without_grace():
+    """END-TO-END (real compiles): warm a 4-mesh — which also compiles
+    the 2-rung reformation executable — then lose chips 2..7 MID-WAVE
+    (only the canonical 2-prefix survives, so the ladder steps 4 → 2
+    rather than sliding sideways onto a same-width survivor
+    placement).  The reform lands on exactly the pre-warmed rung: its
+    shape key is already completed (no compile-grace window armed —
+    the poll branch keys on exactly `shape_completed(B, lanes, 2)`),
+    the re-issued chunks are DECIDED on the reformed rung, and
+    verdicts stay bit-identical to the host oracle."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=4, clock=clock)
+    health.chip_registry().set_clock(clock)
+    vs = make_verifiers(2, tag=b"warmref", bad={0})
+    want = host_verdicts(make_verifiers(2, tag=b"warmref", bad={0}))
+    warm = make_verifiers(1, tag=b"warmref")[0]
+    n_terms = warm.clone()._stage(rng).n_device_terms
+    batch.warm_device_shapes(warm, rng=rng, chunk=2, mesh=4)
+    from ed25519_consensus_tpu.parallel.sharded_msm import shard_pad
+
+    # the grace keys the poll consults are completed BEFORE the storm
+    assert msm.shape_completed(2, shard_pad(n_terms, 4), 4)
+    assert msm.shape_completed(2, shard_pad(n_terms, 2), 2)
+    plan = faults.FaultPlan(
+        [faults.ChipLoss(range(2, 8), on=0, heal_after=600.0)], seed=5)
+    with faults.injected(plan):
+        got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                merge="never", mesh=4, health=hp)
+    stats = dict(batch.last_run_stats)
+    assert got == want == [False, True]
+    refs = stats["mesh_reformations"]
+    assert refs and refs[-1]["from"] == 4 and refs[-1]["to"] == 2
+    participated = (stats["device_batches"]
+                    + stats["device_rejects_confirmed"]
+                    + stats["device_rejects_overturned"])
+    assert participated >= 1, "re-issued work never reached the device"
+    assert not stats["device_sick"]

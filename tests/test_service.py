@@ -606,3 +606,92 @@ def test_legacy_poison_entry_behavior_preserved():
     assert batch._host_verdict(v, rng) is False
     assert batch.verify_single_many(
         [(b"\x00" * 31, b"\x00" * 64, b"x")], rng=rng) == [False]
+
+
+# -- intra-wave dedup (round 11, ROADMAP item 5 first slice) ---------------
+
+
+def test_intra_wave_dedup_decides_once_and_fans_out():
+    """Identical concurrent (sig, key, msg) submissions in one wave
+    are decided ONCE (verify_many sees one representative) and the
+    verdict fans out to every waiter — bit-identical because all
+    waiters receive the single ladder-decided bool."""
+    svc, fc = make_service()
+    seen_sizes = []
+    real = batch.verify_many
+
+    def spy(vs, **kw):
+        seen_sizes.append(len(vs))
+        return real(vs, **kw)
+
+    batch.verify_many = spy
+    try:
+        dup = entries_for(b"dup")
+        tickets = [svc.submit(list(dup)) for _ in range(3)]
+        other = svc.submit(entries_for(b"other"))
+        assert svc.process_once() == 4
+    finally:
+        batch.verify_many = real
+    # the OUTER wave call saw 2 verifiers: 3 duplicates collapsed to
+    # one representative + 1 distinct (later entries are verify_many's
+    # own union-merge recursion re-entering the spied name)
+    assert seen_sizes[0] == 2
+    verdicts = [t.result(5) for t in tickets]
+    assert verdicts == [True, True, True]
+    assert other.result(5) is True
+    assert svc.totals["dedup_fanout"] == 2
+    assert svc.stats()["resolved"] == 4
+    svc.close()
+
+
+def test_intra_wave_dedup_fans_out_false_verdicts_too():
+    svc, fc = make_service()
+    bad = entries_for(b"dupbad", bad=True)
+    tickets = [svc.submit(list(bad)) for _ in range(3)]
+    assert svc.process_once() == 3
+    assert [t.result(5) for t in tickets] == [False, False, False]
+    assert svc.totals["dedup_fanout"] == 2
+    svc.close()
+
+
+def test_dedup_skips_batches_without_a_content_digest():
+    """An exposed coalescing map (or an invalidate()) voids the
+    content digest; such batches must verify individually — full
+    verification is the safe default."""
+    svc, fc = make_service()
+    v1 = batch.Verifier()
+    v2 = batch.Verifier()
+    for vkb, sig, msg in entries_for(b"nodigest"):
+        v1.queue((vkb, sig, msg))
+        v2.queue((vkb, sig, msg))
+    _ = v1.signatures  # exposure retires the queue-order buffers
+    _ = v2.signatures
+    t1, t2 = svc.submit(v1), svc.submit(v2)
+    assert svc.process_once() == 2
+    assert t1.result(5) is True and t2.result(5) is True
+    assert svc.totals["dedup_fanout"] == 0
+    svc.close()
+
+
+def test_content_digest_semantics():
+    """The dedup key: equal queue streams share a digest; message,
+    signature, and key differences split it; exposure and
+    out-of-band invalidation void it."""
+    e = entries_for(b"cd")
+    v1, v2 = batch.Verifier(), batch.Verifier()
+    for item in e:
+        v1.queue(item)
+        v2.queue(item)
+    assert v1.content_digest() == v2.content_digest() is not None
+    v3 = batch.Verifier()
+    v3.queue_bulk(list(e))
+    assert v3.content_digest() == v1.content_digest()  # queue == bulk
+    v4 = batch.Verifier()
+    for vkb, sig, msg in e:
+        v4.queue((vkb, sig, msg + b"x"))
+    assert v4.content_digest() != v1.content_digest()
+    v5 = v1.clone()
+    v5.invalidate("out of band")
+    assert v5.content_digest() is None
+    _ = v2.signatures
+    assert v2.content_digest() is None

@@ -552,3 +552,61 @@ def test_chip_quarantine_drops_shard_residency_like_chip_loss(
         assert ts[tag]["evictions"] == 0
     assert cache.counters["chip_drops"] == 2
     assert cache.counters["quarantine_drops"] == 2
+
+
+# -- tenant-quota auto-sizing (round 11, report-only) ----------------------
+
+
+def test_suggest_tenant_quotas_tilts_toward_missing_tenants():
+    """Equal traffic, different hit rates: the churning tenant (hit
+    rate 0) weighs double the fully-served one (hit rate 1) — 2:1 of
+    the budget — and Σ suggestions never exceeds the budget."""
+    stats = {
+        "hot": {"hits": 100, "misses": 0, "hit_rate": 1.0},
+        "cold": {"hits": 0, "misses": 100, "hit_rate": 0.0},
+    }
+    got = devcache.suggest_tenant_quotas(stats, 3000)
+    assert got == {"cold": 2000, "hot": 1000}
+    assert sum(got.values()) <= 3000
+
+
+def test_suggest_tenant_quotas_scales_with_lookup_share():
+    stats = {
+        "big": {"hits": 300, "misses": 100, "hit_rate": 0.75},
+        "small": {"hits": 75, "misses": 25, "hit_rate": 0.75},
+    }
+    got = devcache.suggest_tenant_quotas(stats, 10_000)
+    assert got["big"] == 4 * got["small"]  # same miss tilt, 4× traffic
+
+
+def test_suggest_tenant_quotas_edge_cases():
+    # no observed lookups → no reservation (the shared pool serves)
+    assert devcache.suggest_tenant_quotas(
+        {"idle": {"hits": 0, "misses": 0, "hit_rate": None}}, 1000) == {}
+    # empty stats / zero budget are empty and zero, never an error
+    assert devcache.suggest_tenant_quotas({}, 1000) == {}
+    got = devcache.suggest_tenant_quotas(
+        {"t": {"hits": 1, "misses": 1, "hit_rate": 0.5}}, 0)
+    assert got == {"t": 0}
+    # a pure function: same snapshot, same suggestion
+    snap = {"a": {"hits": 7, "misses": 3, "hit_rate": 0.7},
+            "b": {"hits": 1, "misses": 9, "hit_rate": 0.1}}
+    assert devcache.suggest_tenant_quotas(snap, 4096) == \
+        devcache.suggest_tenant_quotas(snap, 4096)
+
+
+def test_quota_autosize_is_report_only_behind_the_knob(monkeypatch):
+    cache = devcache.DeviceOperandCache(budget_bytes=1 << 16,
+                                        tenant_quota_bytes=1 << 12)
+    cache.assign_tenant(b"\x01" * 32, "chain-a")
+    cache.lookup(b"\x01" * 32)  # one observed miss for chain-a
+    # knob off (default): no suggestions published anywhere
+    assert cache.quota_suggestions() == {}
+    assert cache.stats()["quota_suggestions"] == {}
+    # knob on: suggestions appear in stats — and ONLY in stats: the
+    # armed quota is untouched (report-only by contract)
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE", "1")
+    st = cache.stats()
+    assert st["quota_suggestions"].get("chain-a", 0) > 0
+    assert cache.tenant_quota_bytes == 1 << 12
+    assert st["tenant_quota_bytes"] == 1 << 12

@@ -165,3 +165,69 @@ def test_lab_device_mode_reports_tenant_hit_rates():
     assert s["lost"] == 0 and s["verdict_mismatches"] == 0
     assert s["by_tenant_devcache"], "tenant hit rates must publish"
     assert s["devcache"]["tenant_rotations"] >= 1
+
+
+# -- fleet mode (round 11, federation) -------------------------------------
+
+
+def make_fleet_cfg(**over):
+    # 12 chains: enough zipf spread that no single replica's HOME load
+    # exceeds its own capacity (with very few heavy chains the hash
+    # can run one replica hot — the 50-chain CI run is the production
+    # shape; this is the deterministic test scale).
+    cfg = make_cfg(fleet=3, chains=12, requests=300,
+                   service_rate=20_000.0, load=0.7,
+                   replica_crash=False, affinity_target=0.5)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_fleet_zero_lost_host_identical_and_replay_digest():
+    s1 = lab.run_fleet(make_fleet_cfg())
+    s2 = lab.run_fleet(make_fleet_cfg())
+    assert s1["lost"] == 0
+    assert s1["verdict_mismatches"] == 0
+    assert s1["ok"], s1["gates"]
+    assert s1["replay_digest"] == s2["replay_digest"]  # pure replay
+    # affinity actually lands: the whole point of the consistent hash
+    assert s1["affinity_hit_rate"] >= 0.5
+    # each chain's keyset warms exactly one replica's namespace in the
+    # steady state (spillover aside)
+    assert s1["requests"] > 0
+
+
+def test_fleet_replica_crash_reissues_and_rejoins():
+    """The ISSUE-13 acceptance case at test scale: killing 1 of 3
+    replicas mid-run loses nothing, verdicts stay host-identical,
+    consensus never sheds while rpc sheds on the survivors, and the
+    ejected replica rejoins through host-verified probes with the
+    post-rejoin affinity hit-rate back over target."""
+    s1 = lab.run_fleet(make_fleet_cfg(replica_crash=True))
+    assert s1["ok"], s1["gates"]
+    g = s1["gates"]
+    assert g["zero_lost"] and g["host_identical_verdicts"]
+    assert g["consensus_shed_rate_zero"]
+    assert g["replica_ejected"] and g["replica_rejoined"]
+    assert g["rpc_sheds_on_survivors"]
+    assert g["tail_affinity_recovered"]
+    fed = s1["federation"]
+    assert fed["ejections"] >= 1 and fed["rejoins"] >= 1
+    # replay: the chaos run is a pure function of the seed too
+    s2 = lab.run_fleet(make_fleet_cfg(replica_crash=True))
+    assert s1["replay_digest"] == s2["replay_digest"]
+
+
+def test_fleet_matrix_shape_and_zipf_skew():
+    m = tenancy.fleet_matrix(50)
+    assert len(m) == 150  # 3 streams per chain
+    assert abs(sum(s.fraction for s in m) - 1.0) < 1e-9
+    tenants = [s.tenant for s in m]
+    assert len(set(tenants)) == 50
+    # zipf: the head chain outweighs the tail chain
+    head = sum(s.fraction for s in m if s.tenant == "chain-000")
+    tail = sum(s.fraction for s in m if s.tenant == "chain-049")
+    assert head > 5 * tail
+    # every class present per chain
+    for t in ("chain-000", "chain-049"):
+        assert {s.cls for s in m if s.tenant == t} == set(tenancy.CLASSES)

@@ -278,7 +278,7 @@ def main(argv=None):
                     choices=["none", "stall", "death", "error",
                              "deadline", "mixed", "churn"])
     ap.add_argument("--json", action="store_true")
-    ap.add_argument("--max-waivers", type=int, default=6,
+    ap.add_argument("--max-waivers", type=int, default=8,
                     help="consensuslint waiver ratchet: fail the soak if "
                          "the committed waiver count exceeds this "
                          "(matches test_waiver_count_is_pinned)")

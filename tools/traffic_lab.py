@@ -63,7 +63,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from ed25519_consensus_tpu import (  # noqa: E402
-    SigningKey, batch, config, devcache, faults, health, service, tenancy,
+    SigningKey, batch, config, devcache, faults, federation, health,
+    routing, service, tenancy,
 )
 from ed25519_consensus_tpu.utils import metrics  # noqa: E402
 
@@ -109,11 +110,14 @@ def tenant_keyset(seed: int, tenant: str, generation: int,
 
 
 class LabRequest:
-    """One submitted batch and its full open-loop accounting."""
+    """One submitted batch and its full open-loop accounting.  The
+    fleet-mode fields (`fed`, `home`, `affinity_hit`, `replica`) stay
+    None in the classic single-service runs."""
 
     __slots__ = ("stream_idx", "seq", "arrival", "cls", "tenant",
                  "sigs", "want", "verifier", "ticket", "kind",
-                 "verdict", "done_at", "deadline")
+                 "verdict", "done_at", "deadline",
+                 "fed", "home", "affinity_hit", "replica")
 
     def __init__(self, stream_idx, seq, arrival, cls, tenant, sigs,
                  want, verifier, deadline):
@@ -130,6 +134,10 @@ class LabRequest:
         self.kind = None       # "verdict" | "overloaded" | "shed_deadline"
         self.verdict = None
         self.done_at = None
+        self.fed = None
+        self.home = None
+        self.affinity_hit = None
+        self.replica = None
 
 
 def build_schedule(matrix, seed, requests_target, load, rate):
@@ -401,6 +409,331 @@ def summarize(cfg, matrix, requests, svc, cache, rate, capacity_sigs,
     return summary
 
 
+def run_fleet(cfg) -> dict:
+    """FLEET mode (round 11, ROADMAP item 4): replay `--chains` chains
+    of Poisson/burst/diurnal arrivals — aggregate offered load
+    `--load` × (fleet size × per-replica rate), which with a pinned
+    `--service-rate` reaches million-user aggregate rates — through a
+    `federation.ReplicaSet` of `--fleet` host-modelled replicas on ONE
+    FakeClock.  Optionally (`--replica-crash`) a seeded ReplicaCrash
+    kills one replica MID-RUN: its queue re-issues on peers, lower
+    classes shed on the survivors, and the ejected replica rejoins
+    through host-verified probes — the whole run a pure function of
+    the seed.
+
+    Gates: zero lost + host-identical verdicts (fleet-wide), consensus
+    shed rate ZERO, per-replica consensus p99 under the deadline, and
+    affinity hit-rate ≥ `--affinity-target` (with a crash: measured on
+    the post-rejoin tail too, so rejoin provably restores affinity)."""
+    chains = max(1, cfg.chains)
+    matrix = tenancy.fleet_matrix(chains)
+    n_rep = int(cfg.fleet)
+    rate = cfg.service_rate or calibrate_service_rate(cfg.seed)
+    fleet_rate = rate * n_rep
+    schedule, horizon = build_schedule(matrix, cfg.seed, cfg.requests,
+                                       cfg.load, fleet_rate)
+    mean_sigs = sum(s.fraction * s.sigs for s in matrix) / sum(
+        s.fraction for s in matrix)
+    capacity_sigs = max(48, int(cfg.capacity_frac * cfg.requests
+                                * mean_sigs / n_rep))
+    t_cap = capacity_sigs / rate
+
+    clock = health.FakeClock()
+    t0 = clock.monotonic()
+
+    class _FleetRegistry(health.ReplicaRegistry):
+        """Registry whose suspicion decay lives on the lab's VIRTUAL
+        timescale (the fleet horizon is a fraction of a second of
+        virtual time; the production 300 s half-life would never relax
+        an eject inside the run).  Behavior, not constants, is under
+        test — the production knobs stay untouched."""
+
+        @staticmethod
+        def _half_life() -> float:
+            return horizon / 40.0
+
+    registry = _FleetRegistry(clock=clock)
+
+    def factory(rid, clk, cache):
+        return service.VerifyService(
+            capacity_sigs=capacity_sigs, clock=clk, auto_start=False,
+            replica_id=f"r{rid}", cache=cache, mesh=0,
+            health=service._HostOnlyHealth(clk),
+            rng=random.Random(_stable_seed(cfg.seed, "fleet-rng", rid)))
+
+    fs = federation.ReplicaSet(
+        n_rep, service_factory=factory, clock=clock, registry=registry,
+        capacity_sigs=capacity_sigs, probe_seed=cfg.seed)
+
+    # The affinity HOME of each tenant (generation 0 — fleet mode runs
+    # without rotation so homes are stable) and the crash victim: the
+    # heaviest chain's home replica, so the outage visibly disturbs
+    # affinity and the rejoin visibly restores it.
+    home_of = {}
+    for s in matrix:
+        if s.tenant in home_of:
+            continue
+        keys = tenant_keyset(cfg.seed, s.tenant, 0, s.sigs)
+        blob = b"".join(sk.verification_key_bytes().to_bytes()
+                        for sk in keys)
+        home_of[s.tenant] = routing.replica_affinity_order(
+            devcache.keyset_digest(blob), s.tenant, range(n_rep))[0]
+    crash_rid = home_of[matrix[0].tenant]
+    crash_t = t0 + 0.35 * horizon if cfg.replica_crash else None
+    crash_state = {"installed": False, "ejected_at": None,
+                   "rejoined_at": None, "rejoins_seen": 0}
+
+    requests, pending = [], []
+    busy = {rid: None for rid in range(n_rep)}
+
+    def submit_one(t, si, seq):
+        req, _gen = build_request(matrix, cfg.seed, si, seq, t,
+                                  0.0, t_cap, t0)
+        requests.append(req)
+        req.home = home_of[req.tenant]
+        try:
+            req.fed = fs.submit(req.verifier, deadline=req.deadline,
+                                cls=req.cls, tenant=req.tenant)
+            req.replica = req.fed.replica_id
+            req.affinity_hit = req.replica == req.home
+            pending.append(req)
+        except service.Overloaded:
+            req.kind = "overloaded"
+            req.done_at = clock.monotonic()
+
+    def sweep(rid):
+        """Collect newly-resolved requests after a pump of `rid`:
+        requests decided BY rid's wave carry its virtual wave cost;
+        requests resolved elsewhere (host floor / failover re-issue
+        racing) land at now."""
+        now = clock.monotonic()
+        live, wave = 0, []
+        for r in [r for r in pending if r.fed.done()]:
+            pending.remove(r)
+            r.replica = r.fed.replica_id
+            try:
+                r.verdict = r.fed.result(0)
+                r.kind = "verdict"
+                if r.replica == rid:
+                    live += r.sigs
+                    wave.append(r)
+                else:
+                    r.done_at = now
+            except service.DeadlineExceeded:
+                r.kind = "shed_deadline"
+                r.done_at = now
+        cost = (cfg.wave_overhead * t_cap + live / rate) if live else 0.0
+        for r in wave:
+            r.done_at = now + cost
+        busy[rid] = (now + cost) if live else None
+
+    def pump(rid):
+        before = fs.totals["rejoins"]
+        fs.pump_replica(rid)
+        fs.maintain()
+        if cfg.replica_crash:
+            if crash_state["ejected_at"] is None \
+                    and fs.totals["ejections"]:
+                crash_state["ejected_at"] = clock.monotonic() - t0
+            if fs.totals["rejoins"] > before \
+                    and crash_state["rejoined_at"] is None:
+                crash_state["rejoined_at"] = clock.monotonic() - t0
+        sweep(rid)
+
+    def queued(rid):
+        return fs.replicas[rid].service.stats()["queue_requests"]
+
+    i = 0
+    while True:
+        if crash_t is not None and not crash_state["installed"] \
+                and clock.monotonic() >= crash_t:
+            faults.install(faults.replica_plan(
+                cfg.seed, "crash", replica=crash_rid, at=0))
+            crash_state["installed"] = True
+        busy_next = [(t, rid) for rid, t in busy.items()
+                     if t is not None]
+        t_busy, rid_busy = min(busy_next) if busy_next else (None, None)
+        t_arr = schedule[i][0] + t0 if i < len(schedule) else None
+        if t_busy is not None and (t_arr is None or t_busy <= t_arr):
+            clock.advance_to(t_busy)
+            busy[rid_busy] = None
+            pump(rid_busy)
+        elif t_arr is not None:
+            clock.advance_to(t_arr)
+            submit_one(*schedule[i])
+            i += 1
+            for rid in range(n_rep):
+                if busy[rid] is None and queued(rid):
+                    pump(rid)
+        else:
+            progressed = False
+            for rid in range(n_rep):
+                if busy[rid] is None and queued(rid):
+                    pump(rid)
+                    progressed = True
+            if not progressed:
+                if pending:
+                    # Only maintenance work (probes, drains) is left:
+                    # advance the virtual clock a beat so decay-gated
+                    # transitions can fire, then try again.
+                    clock.advance(horizon / 100.0)
+                    fs.maintain()
+                    for rid in range(n_rep):
+                        pump(rid)
+                    continue
+                break
+    fs.close()
+    if crash_state["installed"]:
+        faults.uninstall()
+    now = clock.monotonic()
+    for r in list(pending):
+        # close() drained every live replica; anything left resolves
+        # now (zero-lost means this sweep finds only done tickets).
+        if r.fed.done():
+            r.replica = r.fed.replica_id
+            try:
+                r.verdict = r.fed.result(0)
+                r.kind = "verdict"
+            except service.DeadlineExceeded:
+                r.kind = "shed_deadline"
+            r.done_at = now
+            pending.remove(r)
+
+    return summarize_fleet(cfg, matrix, requests, fs, rate,
+                           capacity_sigs, t_cap, horizon, t0,
+                           crash_rid if cfg.replica_crash else None,
+                           crash_state)
+
+
+def summarize_fleet(cfg, matrix, requests, fs, rate, capacity_sigs,
+                    t_cap, horizon, t0, crash_rid, crash_state) -> dict:
+    n_rep = int(cfg.fleet)
+    lost = sum(1 for r in requests if r.kind is None)
+    mismatches = sum(1 for r in requests
+                     if r.kind == "verdict" and r.verdict != r.want)
+
+    def class_rows(rs):
+        rows = {}
+        for cls in tenancy.CLASSES:
+            crs = [r for r in rs if r.cls == cls]
+            lats = [r.done_at - (t0 + r.arrival) for r in crs
+                    if r.kind == "verdict"]
+            pct = metrics.percentiles(lats)
+            shed = sum(1 for r in crs
+                       if r.kind in ("overloaded", "shed_deadline"))
+            deadlines = [s.deadline_s * t_cap for s in matrix
+                         if s.cls == cls and s.deadline_s is not None]
+            rows[cls] = {
+                "requests": len(crs),
+                "shed_rate": round(shed / len(crs), 4) if crs else 0.0,
+                "deadline_s": min(deadlines) if deadlines else None,
+                "p50": pct[0.5], "p99": pct[0.99],
+            }
+        return rows
+
+    by_replica = {}
+    for rid in range(n_rep):
+        rs = [r for r in requests if r.replica == rid]
+        homed = [r for r in requests if r.home == rid
+                 and r.affinity_hit is not None]
+        rows = class_rows(rs)
+        cons = rows[tenancy.CLASS_CONSENSUS]
+        by_replica[rid] = {
+            "requests": len(rs),
+            "affinity_hit_rate": (
+                round(sum(1 for r in homed if r.affinity_hit)
+                      / len(homed), 4) if homed else None),
+            "by_class": rows,
+            "consensus_p99_s": cons["p99"],
+            "consensus_deadline_s": cons["deadline_s"],
+            "crashed": rid == crash_rid,
+        }
+
+    fleet_rows = class_rows(requests)
+    cons = fleet_rows[tenancy.CLASS_CONSENSUS]
+    affinity_pairs = [r for r in requests if r.affinity_hit is not None]
+    affinity_rate = (sum(1 for r in affinity_pairs if r.affinity_hit)
+                     / len(affinity_pairs)) if affinity_pairs else None
+
+    gates = {
+        "zero_lost": lost == 0,
+        "host_identical_verdicts": mismatches == 0,
+        "consensus_shed_rate_zero":
+            fleet_rows[tenancy.CLASS_CONSENSUS]["shed_rate"] == 0.0,
+        "consensus_p99_under_deadline_per_replica": all(
+            row["consensus_p99_s"] is None
+            or (row["consensus_deadline_s"] is not None
+                and row["consensus_p99_s"] < row["consensus_deadline_s"])
+            for row in by_replica.values()),
+        "affinity_hit_rate_met": (
+            affinity_rate is not None
+            and affinity_rate >= cfg.affinity_target),
+    }
+    tail_affinity = None
+    if crash_rid is not None:
+        rejoined_at = crash_state["rejoined_at"]
+        tail = [r for r in requests
+                if rejoined_at is not None and r.arrival > rejoined_at
+                and r.affinity_hit is not None]
+        tail_affinity = (round(sum(1 for r in tail if r.affinity_hit)
+                               / len(tail), 4) if tail else None)
+        # Only sheds ARRIVING AFTER the ejection count: rpc routinely
+        # sheds a little pre-crash at this load, and the gate's claim
+        # is that the OUTAGE pushes the surviving replicas into
+        # shedding — a fleet-lifetime count would pass vacuously.
+        ejected_at = crash_state["ejected_at"]
+        survivors_rpc_shed = sum(
+            1 for r in requests
+            if r.cls == tenancy.CLASS_RPC
+            and r.kind in ("overloaded", "shed_deadline")
+            and ejected_at is not None and r.arrival > ejected_at)
+        gates.update({
+            "replica_ejected": crash_state["ejected_at"] is not None,
+            "replica_rejoined": rejoined_at is not None,
+            "rpc_sheds_on_survivors": survivors_rpc_shed > 0,
+            "tail_affinity_recovered": (
+                tail_affinity is not None
+                and tail_affinity >= cfg.affinity_target),
+        })
+
+    digest = hashlib.sha256()
+    for r in requests:
+        digest.update(repr((r.stream_idx, r.seq, round(r.arrival, 9),
+                            r.kind, r.verdict, r.replica,
+                            None if r.done_at is None
+                            else round(r.done_at - t0, 9))).encode())
+
+    st = fs.stats()
+    return {
+        "ok": all(gates.values()),
+        "gates": gates,
+        "seed": cfg.seed,
+        "fleet": n_rep,
+        "chains": cfg.chains,
+        "requests": len(requests),
+        "lost": lost,
+        "verdict_mismatches": mismatches,
+        "load": cfg.load,
+        "service_rate_sigs_per_s": round(rate, 1),
+        "aggregate_rate_sigs_per_s": round(rate * n_rep * cfg.load, 1),
+        "calibrated": not cfg.service_rate,
+        "capacity_sigs_per_replica": capacity_sigs,
+        "t_cap_s": t_cap,
+        "horizon_s": horizon,
+        "affinity_hit_rate": (round(affinity_rate, 4)
+                              if affinity_rate is not None else None),
+        "tail_affinity_hit_rate": tail_affinity,
+        "crash_replica": crash_rid,
+        "crash_state": dict(crash_state),
+        "by_class": fleet_rows,
+        "by_replica": by_replica,
+        "federation": {k: v for k, v in st.items()
+                       if k not in ("replicas",)},
+        "replicas": st["replicas"],
+        "replay_digest": digest.hexdigest(),
+    }
+
+
 def parse_load_sweep(spec: str) -> "list[float]":
     """Parse a --load-sweep spec: either a comma list ("0.5,0.8,1.2")
     or lo:hi:n ("0.5:1.2:8" — n evenly-spaced points inclusive)."""
@@ -502,6 +835,21 @@ def main(argv=None):
                     action="store_true", default=True)
     ap.add_argument("--no-require-rpc-shed", dest="require_rpc_shed",
                     action="store_false")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="FLEET mode: run the federation lab through a "
+                         "ReplicaSet of this many host-modelled "
+                         "replicas instead of one service (0 = off)")
+    ap.add_argument("--chains", type=int, default=50,
+                    help="fleet mode: chain (tenant) count for the "
+                         "zipf-skewed fleet matrix")
+    ap.add_argument("--replica-crash", action="store_true",
+                    help="fleet mode: seeded ReplicaCrash kills the "
+                         "heaviest chain's home replica mid-run; gates "
+                         "add ejection + probe rejoin + post-rejoin "
+                         "affinity recovery")
+    ap.add_argument("--affinity-target", type=float, default=0.6,
+                    help="fleet mode: minimum acceptable affinity "
+                         "hit-rate (overall and post-rejoin tail)")
     ap.add_argument("--load-sweep", default="",
                     help="drive the load axis and emit the latency-vs-"
                          "load curve into the service_slo block: a "
@@ -510,6 +858,47 @@ def main(argv=None):
                          "--load still executes first")
     ap.add_argument("--json", action="store_true")
     cfg = ap.parse_args(argv)
+
+    if cfg.fleet:
+        if not cfg.seed or cfg.seed == config.get(
+                "ED25519_TPU_TRAFFIC_LAB_SEED"):
+            cfg.seed = config.get("ED25519_TPU_FLEET_LAB_SEED")
+        summary = run_fleet(cfg)
+        if cfg.json:
+            print(json.dumps(summary, indent=2, sort_keys=True))
+        cons = summary["by_class"][tenancy.CLASS_CONSENSUS]
+        print(json.dumps({
+            "metric": "fleet_slo",
+            "value": (round(cons["p99"] * 1e3, 3)
+                      if cons["p99"] is not None else None),
+            "unit": "ms_p99_consensus_verdict_latency",
+            "fleet": summary["fleet"],
+            "chains": summary["chains"],
+            "aggregate_rate_sigs_per_s":
+                summary["aggregate_rate_sigs_per_s"],
+            "affinity_hit_rate": summary["affinity_hit_rate"],
+            "tail_affinity_hit_rate": summary["tail_affinity_hit_rate"],
+            "zero_lost": summary["gates"]["zero_lost"],
+            "host_identical":
+                summary["gates"]["host_identical_verdicts"],
+            "shed_rate_by_class": {
+                cls: summary["by_class"][cls]["shed_rate"]
+                for cls in tenancy.CLASSES},
+            "crash_replica": summary["crash_replica"],
+            "replay_digest": summary["replay_digest"],
+            "ok": summary["ok"],
+        }))
+        print("FLEET_SLO", json.dumps(
+            {k: v for k, v in summary.items()
+             if k not in ("by_class", "by_replica", "replicas")}))
+        if not summary["ok"]:
+            failed = [g for g, ok in summary["gates"].items() if not ok]
+            print(f"VIOLATION: fleet_slo gates failed: {failed} "
+                  f"(replay with --seed {summary['seed']:#x})",
+                  file=sys.stderr)
+        sys.stdout.flush()
+        batch._DeviceLane.reset_all(timeout=30.0)
+        os._exit(0 if summary["ok"] else 1)
 
     if cfg.device:
         from chaos_soak import warm_shapes  # same tools/ dir
