@@ -19,6 +19,7 @@ from . import (
     serde,
     service,
     tenancy,
+    verdictcache,
 )
 from .error import (
     Error,
@@ -59,4 +60,5 @@ __all__ = [
     "serde",
     "service",
     "tenancy",
+    "verdictcache",
 ]

@@ -27,6 +27,18 @@ numbered invariant catalog (docs/consensus-invariants.md):
   or overbroad `except`, and no verdict aggregation driven by dict/set
   iteration order (the shape of the old `verify_single_many`
   poison-entry map surgery).
+* **CL007 verdict-cache write-path discipline** (round 12) — the
+  verdict memo store (verdictcache.py) is READ-ONLY on the verdict
+  path: no verdict-aggregation symbol (`verify_many`, `_host_verdict`,
+  `VerifyService._execute`, ...) may call a cache write method
+  (`store`/`put`/`record_verdict`) — stores belong to
+  `process_once`, after the wave's tickets are sealed — and no code
+  outside verdictcache.py may reach a cache entry except through
+  `lookup()` (raw `_entries` / `_lookup_locked` access bypasses the
+  per-hit re-hash guard).  Like CL006, a syntactic approximation of
+  the reachability claim: the direct-call shape is what the rule can
+  see, and the corrupt-stored-verdict fault tests pin the semantic
+  half (a flipped stored verdict is never published).
 
 Findings are `(rule, path, line, symbol, message)`; a committed waiver
 (`waivers.toml`) may suppress a finding by (rule, path, symbol) with a
@@ -54,7 +66,8 @@ WAIVERS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 MANIFEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "jaxpr_manifest.json")
 
-RULE_IDS = ("CL001", "CL002", "CL003", "CL004", "CL005", "CL006")
+RULE_IDS = ("CL001", "CL002", "CL003", "CL004", "CL005", "CL006",
+            "CL007")
 
 # CL001 scope inside batch.py: the symbols on the verdict path (staging,
 # exact verification, the union/bisection machinery).  The scheduler
@@ -81,9 +94,9 @@ _FLOAT_DTYPES = frozenset(
 # invocation passes it explicitly.)
 _CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
                   "faults.py", "devcache.py", "tenancy.py",
-                  "federation.py",
+                  "federation.py", "verdictcache.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
-                  "tools/sentinel_soak.py")
+                  "tools/sentinel_soak.py", "tools/replay_lab.py")
 _CL004_ALLOWED = {
     "batch.py": frozenset((
         "_shift128_cache", "_key_row_cache", "_host_split_cache",
@@ -107,15 +120,19 @@ _CL004_ALLOWED = {
     # itself as a module global (the old batch.py shape) is exactly
     # what CL004 exists to reject — pinned by a negative fixture.
     "devcache.py": frozenset(("_default",)),
+    # Same injectable-singleton discipline for the verdict memo store
+    # (round 12): the store dict as a module global would be ambient
+    # cross-service verdict state — exactly what CL004 rejects.
+    "verdictcache.py": frozenset(("_default",)),
 }
 _LOCK_CONSTRUCTORS = frozenset(
     ("Lock", "RLock", "Condition", "Event", "Semaphore",
      "BoundedSemaphore", "Barrier"))
 
 _CL006_MODULES = ("batch.py", "service.py", "tenancy.py",
-                  "federation.py",
+                  "federation.py", "verdictcache.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
-                  "tools/sentinel_soak.py")
+                  "tools/sentinel_soak.py", "tools/replay_lab.py")
 _CL005_SECRET_ATTRS = frozenset(("s", "prefix"))
 _CL005_SECRET_CALLS = frozenset(("to_bytes", "__bytes__"))
 
@@ -501,6 +518,87 @@ def _check_cl006(mod: ParsedModule):
                         f"never by dict/set iteration order")
 
 
+# CL007 (round 12): the verdict memo store is read-only on the verdict
+# path.  Scope: the modules that can reach a VerdictCache.  Two checks:
+#
+# * WRITE-ON-DECIDE — inside the verdict-aggregation symbols, any call
+#   to a cache WRITE verb on a cache-named receiver is a finding: a
+#   store that happens as a side effect of deciding couples the memo
+#   layer into the verdict math (the stores belong to process_once,
+#   after every ticket is sealed).
+# * UNGUARDED READ — outside verdictcache.py itself, any access to the
+#   raw entry map (`_entries`) or the unguarded lookup internals
+#   (`_lookup_locked`, `peek`) on a cache-named receiver is a finding:
+#   `lookup()` is the only read API, because it is where the per-hit
+#   byte-for-byte re-hash lives — a verdict derived from an entry that
+#   skipped it would trust stored bytes nothing re-checked.
+#
+# Like CL006 this is a syntactic approximation (direct calls, not a
+# call graph); the semantic half — a flipped stored verdict is never
+# published — is pinned by the CorruptStoredVerdict fault tests.
+_CL007_MODULES = ("batch.py", "service.py", "verdictcache.py",
+                  "federation.py", "tools/replay_lab.py")
+_CL007_VERDICT_SYMBOLS = (
+    "verify_many", "_host_verdict", "_resolve_union",
+    "verify_single_many", "Verifier.verify", "VerifyService._execute",
+)
+_CL007_WRITE_METHODS = frozenset(
+    ("store", "put", "record_verdict", "insert"))
+_CL007_RAW_READS = frozenset(("_entries", "_lookup_locked", "peek"))
+_CL007_RECEIVER_HINTS = ("cache", "vc", "memo")
+
+
+def _cl007_cache_receiver(node) -> bool:
+    """Heuristic: does this attribute/call receiver name a cache?  Any
+    Name id or Attribute attr along the chain containing a receiver
+    hint ("cache", "vc", "memo") counts — self.verdict_cache, vc,
+    rep.vcache, memo_store all match."""
+    parts = []
+    n = node
+    while isinstance(n, ast.Attribute):
+        parts.append(n.attr)
+        n = n.value
+    if isinstance(n, ast.Name):
+        parts.append(n.id)
+    return any(any(h in p.lower() for h in _CL007_RECEIVER_HINTS)
+               for p in parts)
+
+
+def _check_cl007(mod: ParsedModule):
+    rel = _pkg_rel(mod.relpath)
+    if rel not in _CL007_MODULES:
+        return
+    is_verdictcache = rel == "verdictcache.py"
+
+    def in_verdict_symbol(node) -> bool:
+        sym = mod.symbol_of(node)
+        return any(sym == s or sym.startswith(s + ".")
+                   for s in _CL007_VERDICT_SYMBOLS)
+
+    for node in mod.walk():
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _CL007_WRITE_METHODS \
+                and _cl007_cache_receiver(node.func.value) \
+                and in_verdict_symbol(node):
+            yield Finding(
+                "CL007", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"verdict-cache write `.{node.func.attr}()` inside "
+                f"verdict aggregation — the memo store is read-only "
+                f"on the verdict path; stores belong to the "
+                f"post-wave bookkeeping (VerifyService.process_once)")
+        elif not is_verdictcache and isinstance(node, ast.Attribute) \
+                and node.attr in _CL007_RAW_READS \
+                and _cl007_cache_receiver(node.value):
+            yield Finding(
+                "CL007", mod.relpath, node.lineno, node.col_offset,
+                mod.symbol_of(node),
+                f"raw verdict-cache entry access `.{node.attr}` "
+                f"bypasses the per-hit re-hash guard — go through "
+                f"VerdictCache.lookup()")
+
+
 RULES = {
     "CL001": _check_cl001,
     "CL002": _check_cl002,
@@ -508,6 +606,7 @@ RULES = {
     "CL004": _check_cl004,
     "CL005": _check_cl005,
     "CL006": _check_cl006,
+    "CL007": _check_cl007,
 }
 
 

@@ -721,22 +721,52 @@ class Verifier:
             return b"".join(k.to_bytes() for k in self._key_index)
         return b"".join(k.to_bytes() for k in self._materialized())
 
+    def content_payload(self) -> "bytes | None":
+        """The canonical content PAYLOAD of the queued batch — the
+        exact byte string `content_digest()` hashes: a domain prefix,
+        the batch size, the canonical keyset blob, the per-signature
+        group ids, and the flat (s, R, k) queue-order buffers.  The
+        verdict cache (verdictcache.py, round 12) stores this payload
+        alongside a memoized verdict and re-hashes it byte-for-byte on
+        every hit — the same bytes-or-nothing discipline as the
+        devcache hash pinning.
+
+        None under exactly the `content_digest()` conditions: exposed
+        coalescing map or out-of-band `invalidate()` — content that
+        cannot vouch for itself is never addressed by it."""
+        if not self._buffers_live() or self._invalid is not None:
+            return None
+        return b"".join((
+            b"ed25519-tpu-batch-content-v1",
+            self.batch_size.to_bytes(8, "little"),
+            self._canonical_keyset_blob() or b"",
+            self._gid.tobytes(),
+            bytes(self._s_buf),
+            bytes(self._r_buf),
+            bytes(self._k_buf),
+        ))
+
     def content_digest(self) -> "bytes | None":
         """Content address of the QUEUED BATCH itself (round 11, the
-        service layer's intra-wave dedup key): SHA-256 over the batch
-        size, the canonical keyset blob, the per-signature group ids,
-        and the flat (s, R, k) queue-order buffers.  Since the
-        challenge k = H(R‖A‖M) binds the message, two verifiers share
-        a digest iff they received byte-identical (vk, sig, msg)
+        service layer's intra-wave dedup key; round 12, the verdict
+        cache's memo key): SHA-256 over `content_payload()`.  Since
+        the challenge k = H(R‖A‖M) binds the message, two verifiers
+        share a digest iff they received byte-identical (vk, sig, msg)
         queue streams — exactly the "identical concurrent submission"
-        the dedup fans one ladder-decided verdict out to.
+        the dedup fans one ladder-decided verdict out to, and the
+        "replayed leg" a memoized verdict may answer.
 
         None when the digest cannot vouch for the contents: queue-
         order buffers not live (the coalescing map was exposed and may
         have been mutated count-neutrally) or the batch was
         `invalidate()`d out-of-band (intent is not content).  A None
-        digest simply never dedups — full verification is the safe
-        default."""
+        digest simply never dedups — and never touches the verdict
+        cache — full verification is the safe default.
+
+        Streams the payload parts through the hash (no concatenated
+        copy — this runs on EVERY service submit); the digest is
+        bitwise sha256(content_payload()) by construction, which the
+        verdict cache's store path relies on."""
         if not self._buffers_live() or self._invalid is not None:
             return None
         h = hashlib.sha256(b"ed25519-tpu-batch-content-v1")

@@ -267,6 +267,23 @@ KNOBS: "dict[str, Knob]" = dict([
        "(devcache.suggest_tenant_quotas) and publish them in "
        "stats()[\"quota_suggestions\"]; never changes the armed "
        "quotas."),
+    _k("ED25519_TPU_VERDICT_CACHE_ENABLED", "opt-out", True,
+       "Set to 0/false/no to disable the content-addressed verdict "
+       "cache (verdictcache.py — the mempool→consensus double-verify "
+       "memo); every submission then verifies in full."),
+    _k("ED25519_TPU_VERDICT_CACHE_BYTES", "int", 1 << 24,
+       "Verdict cache residency budget in bytes (stored content "
+       "payloads; deterministic LRU eviction above it); 0 also "
+       "disables memoization."),
+    _k("ED25519_TPU_VERDICT_CACHE_TENANT_QUOTA", "int", 0,
+       "Per-tenant verdict-cache residency quota in bytes: >0 "
+       "partitions the byte budget so one tenant's replay churn can "
+       "never evict another tenant's memoized verdicts; 0 keeps the "
+       "single shared LRU pool."),
+    _k("ED25519_TPU_REPLAY_LAB_SEED", "int", 0x2E91A1,
+       "Default seed for tools/replay_lab.py's mempool→block→vote-"
+       "replay scenario, fresh-traffic interleaving, and fault "
+       "windows (the run is a pure function of it)."),
 ])
 
 

@@ -735,17 +735,22 @@ class DeviceOperandCache:
 
     # -- observability -----------------------------------------------------
 
-    def quota_suggestions(self) -> "dict[str, int]":
+    def quota_suggestions(self, verdict_stats: "dict | None" = None
+                          ) -> "dict[str, int]":
         """Report-only per-tenant quota suggestions derived from the
         OBSERVED lookup pattern (`suggest_tenant_quotas` over
         `tenant_stats()` — the ROADMAP item 4 auto-sizing follow-up).
-        Never changes the armed quotas: an operator reads these next
-        to the hit rates and decides.  Empty unless the
+        Pass a `verdictcache.VerdictCache.tenant_stats()` snapshot as
+        `verdict_stats` to fold memo-store demand into the same split
+        (round 12 — one sizing function covers both caches).  Never
+        changes the armed quotas: an operator reads these next to the
+        hit rates and decides.  Empty unless the
         ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE knob is on."""
         if not _config.get("ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE"):
             return {}
         return suggest_tenant_quotas(self.tenant_stats(),
-                                     self.budget_bytes)
+                                     self.budget_bytes,
+                                     verdict_stats=verdict_stats)
 
     def stats(self) -> dict:
         suggestions = self.quota_suggestions()
@@ -806,35 +811,45 @@ class DeviceOperandCache:
 
 
 def suggest_tenant_quotas(tenant_stats: "dict[str, dict]",
-                          budget_bytes: int) -> "dict[str, int]":
+                          budget_bytes: int,
+                          verdict_stats: "dict[str, dict] | None" = None
+                          ) -> "dict[str, int]":
     """Per-tenant quota SUGGESTIONS from observed demand (ROADMAP item
     4 follow-up; report-only — `DeviceOperandCache.quota_suggestions`
     gates publication behind ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE).
 
-    A pure function of (tenant_stats snapshot, budget): each tenant's
-    demand weight is
+    A pure function of (tenant_stats snapshot, budget, and — round
+    12 — an optional VERDICT-CACHE tenant_stats snapshot): each
+    tenant's demand weight is
 
         lookups · (1 + miss_rate)
 
-    — its observed traffic share, tilted toward tenants whose hit rate
-    is LOW (a churning or under-provisioned tenant needs quota more
-    than one already serving every lookup from residency; a tenant
-    with hit rate 1.0 weighs exactly its lookup share, one with hit
-    rate 0.0 weighs double).  The budget is split proportionally and
-    floored to ints, so Σ suggestions ≤ budget always; tenants with no
-    observed lookups suggest 0 (no evidence, no reservation — the
-    shared pool serves them until they show up).  Suggestions are
-    operator input, never armed state: eviction still only ever obeys
-    `tenant_quota_bytes`."""
+    summed over both caches — its observed traffic share, tilted
+    toward tenants whose hit rate is LOW (a churning or
+    under-provisioned tenant needs quota more than one already serving
+    every lookup from residency; a tenant with hit rate 1.0 weighs
+    exactly its lookup share, one with hit rate 0.0 weighs double).
+    Folding `verdictcache.VerdictCache.tenant_stats()` in as
+    `verdict_stats` lets ONE sizing function cover both caches: a
+    tenant replaying heavily (verdict-cache demand) and a tenant
+    churning keysets (devcache demand) both surface in the same
+    per-tenant split.  The budget is split proportionally and floored
+    to ints, so Σ suggestions ≤ budget always; tenants with no
+    observed lookups in either cache suggest 0 (no evidence, no
+    reservation — the shared pool serves them until they show up).
+    Suggestions are operator input, never armed state: eviction still
+    only ever obeys the respective cache's `tenant_quota_bytes`."""
     budget = max(0, int(budget_bytes))
-    weights = {}
-    for tenant, st in tenant_stats.items():
-        looked = st.get("hits", 0) + st.get("misses", 0)
-        if looked <= 0:
-            continue
-        hit_rate = st.get("hit_rate")
-        miss_rate = 1.0 - (hit_rate if hit_rate is not None else 1.0)
-        weights[tenant] = looked * (1.0 + miss_rate)
+    weights: "dict[str, float]" = {}
+    for stats_map in (tenant_stats, verdict_stats or {}):
+        for tenant, st in stats_map.items():
+            looked = st.get("hits", 0) + st.get("misses", 0)
+            if looked <= 0:
+                continue
+            hit_rate = st.get("hit_rate")
+            miss_rate = 1.0 - (hit_rate if hit_rate is not None else 1.0)
+            weights[tenant] = weights.get(tenant, 0.0) \
+                + looked * (1.0 + miss_rate)
     total = sum(weights.values())
     if total <= 0 or budget <= 0:
         return {t: 0 for t in weights}

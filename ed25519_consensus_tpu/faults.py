@@ -63,8 +63,10 @@ __all__ = [
     "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
     "RotateTenant", "ChipLoss", "LinkFlap",
     "ReplicaCrash", "ReplicaWedge", "SplitCapacity",
+    "CorruptStoredVerdict",
     "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
     "mesh_plan", "sentinel_plan", "typed_error_plan", "replica_plan",
+    "verdictcache_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
 ]
@@ -81,6 +83,12 @@ SITE_DEVCACHE = "devcache"
 # being pumped, so whole-replica faults can target one replica out of
 # the fleet.
 SITE_REPLICA = "replica"
+# The verdict cache's lookup boundary (verdictcache.py): "call index"
+# counts memo lookups, and ctx.payload is the VerdictCache itself, so
+# stored-verdict corruption / evict storms / stale epochs land
+# deterministically between a submission and the memo it would have
+# been served from.
+SITE_VERDICTCACHE = "verdictcache"
 
 
 class InjectedFault(RuntimeError):
@@ -611,10 +619,13 @@ class EvictStorm(Fault):
     """Drop EVERY resident entry at the faulted lookup (ctx.payload is
     the cache) — the shape of memory-pressure eviction hitting exactly
     when the entry was about to be used.  The lookup becomes a miss and
-    the batch restages from scratch: verdict-neutral by construction."""
+    the batch restages from scratch: verdict-neutral by construction.
+    `site` defaults to the devcache seam; the verdict cache's lookup
+    stream (SITE_VERDICTCACHE) rides the same fault — both payloads
+    expose `drop_all`."""
 
-    def __init__(self, on=0):
-        super().__init__(on=on, site=SITE_DEVCACHE)
+    def __init__(self, on=0, site: str = SITE_DEVCACHE):
+        super().__init__(on=on, site=site)
 
     def before(self, ctx):
         if ctx.payload is not None:
@@ -625,14 +636,46 @@ class StaleEpochOn(Fault):
     """Bump the cache epoch at the faulted lookup, so the entry about
     to be returned is stale (as if an out-of-band invalidation landed
     between staging and dispatch).  The cache treats a stale-epoch hit
-    as a miss and restages."""
+    as a miss and restages.  `site` defaults to the devcache seam; on
+    SITE_VERDICTCACHE the stale memo degrades to a full verification —
+    both payloads expose `bump_epoch`."""
 
-    def __init__(self, on=0):
-        super().__init__(on=on, site=SITE_DEVCACHE)
+    def __init__(self, on=0, site: str = SITE_DEVCACHE):
+        super().__init__(on=on, site=site)
 
     def before(self, ctx):
         if ctx.payload is not None:
             ctx.payload.bump_epoch("stale-epoch fault")
+
+
+class CorruptStoredVerdict(Fault):
+    """Flip the STORED VERDICT BIT of the looked-up verdict-cache entry
+    (SITE_VERDICTCACHE; `out` is the entry the lookup found) — the
+    adversarial direction for a memo store: a bit of rot that turns a
+    recorded reject into an accept (or vice versa) without touching the
+    stored payload bytes.  The cache's per-hit re-hash runs AFTER this
+    seam and re-derives the verdict SEAL from (digest, verdict): the
+    flipped bit fails the seal, the entry drops, and the submission
+    verifies in full — a corrupted stored verdict is never published
+    (tools/replay_lab.py and tests/test_verdictcache.py pin exactly
+    this).  `flip_payload` additionally flips payload bytes (caught by
+    the digest re-hash instead — either gate alone suffices)."""
+
+    def __init__(self, on=0, flip_payload: bool = False):
+        super().__init__(on=on, site=SITE_VERDICTCACHE)
+        self.flip_payload = bool(flip_payload)
+
+    def after(self, ctx, out):
+        if out is not None:
+            out.verdict = not out.verdict
+            if self.flip_payload:
+                rng = random.Random(_stable_seed(
+                    ctx.plan.seed, ctx.site, ctx.index, "verdict"))
+                b = bytearray(out.payload)
+                if b:
+                    b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+                out.payload = bytes(b)
+        return out
 
 
 class RotateTenant(Fault):
@@ -829,6 +872,38 @@ def devcache_plan(seed: int, kind: str, at: int = 0,
         faults = [RotateTenant(on=window, tenant=tenant)]
     else:
         raise ValueError(f"unknown devcache fault kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
+def verdictcache_plan(seed: int, kind: str, at: int = 0,
+                      length: int = 1) -> FaultPlan:
+    """A fault window over the VERDICT-CACHE lookup stream
+    (SITE_VERDICTCACHE; indices count memo lookups, not device calls):
+
+    * ``"corrupt-verdict"`` — flip the stored verdict bit of the
+      looked-up entry (caught by the per-hit seal re-hash: the entry
+      drops and the submission verifies in full — the flipped verdict
+      is NEVER published);
+    * ``"corrupt-payload"`` — flip the stored verdict AND a payload
+      byte (caught by the digest re-hash);
+    * ``"evict"``   — drop every stored verdict at the faulted lookups
+      (an eviction storm; lookups become misses);
+    * ``"stale"``   — bump the cache epoch at the faulted lookups (the
+      memo about to be served goes stale and the batch re-verifies).
+
+    Same replay property as every other plan: decisions are pure
+    functions of (seed, site, call index)."""
+    window = range(at, at + max(1, length))
+    if kind == "corrupt-verdict":
+        faults = [CorruptStoredVerdict(on=window)]
+    elif kind == "corrupt-payload":
+        faults = [CorruptStoredVerdict(on=window, flip_payload=True)]
+    elif kind == "evict":
+        faults = [EvictStorm(on=window, site=SITE_VERDICTCACHE)]
+    elif kind == "stale":
+        faults = [StaleEpochOn(on=window, site=SITE_VERDICTCACHE)]
+    else:
+        raise ValueError(f"unknown verdictcache fault kind {kind!r}")
     return FaultPlan(faults, seed=seed)
 
 

@@ -78,6 +78,7 @@ from . import health as _health
 from . import routing as _routing
 from . import service as _service
 from . import tenancy as _tenancy
+from . import verdictcache as _verdictcache
 from .utils import metrics as _metrics
 
 __all__ = ["FederatedTicket", "Replica", "ReplicaSet"]
@@ -142,17 +143,22 @@ class FederatedTicket:
 
 class Replica:
     """One managed replica: identity, its `VerifyService`, its
-    NAMESPACED device-operand cache, and the degraded-capacity seam
-    the SplitCapacity fault (and a real per-replica capacity monitor)
-    writes.  Pure placement/observability state — never verdicts."""
+    NAMESPACED device-operand cache, its NAMESPACED verdict cache
+    (round 12 — memoized verdicts are per-replica state exactly like
+    residency: an affinity home serves its recurring content from its
+    own memo store, and an ejected replica's store dies with it), and
+    the degraded-capacity seam the SplitCapacity fault (and a real
+    per-replica capacity monitor) writes.  Pure placement/
+    observability state — never verdicts."""
 
-    __slots__ = ("rid", "service", "cache", "degraded_frac", "pumps",
-                 "crashed")
+    __slots__ = ("rid", "service", "cache", "vcache", "degraded_frac",
+                 "pumps", "crashed")
 
-    def __init__(self, rid: int, service, cache):
+    def __init__(self, rid: int, service, cache, vcache=None):
         self.rid = int(rid)
         self.service = service
         self.cache = cache
+        self.vcache = vcache
         # None = derive from the service's own effective capacity (the
         # PR 8 watermark shrink); a float is an externally-reported
         # fraction (SplitCapacity fault / operator / fleet monitor).
@@ -226,16 +232,37 @@ class ReplicaSet:
             "reissued": 0, "host_floor": 0, "ejections": 0,
             "drains_started": 0, "rejoins": 0, "revivals": 0,
             "probes": 0, "probe_failures": 0,
+            # Front-door dedup (round 12, PR 13's intra-wave dedup
+            # lifted to the federation boundary): identical concurrent
+            # submissions for the same affinity home share ONE
+            # federated ticket — one placement, one wave slot, one
+            # ladder decision fanned out to every submitter.
+            "dedup_fanout": 0,
         }
         self.error_classes = {_health.ERROR_TRANSIENT: 0,
                               _health.ERROR_FATAL: 0,
                               _health.ERROR_AMBIGUOUS: 0}
+        # content-digest → (FederatedTicket, deadline, rid): the
+        # front-door dedup ledger, pruned of resolved entries on every
+        # maintain() (bounded by the fleet's unresolved depth).
+        self._front_dedup: "dict" = {}
+        self._dedup_by_replica: "dict[int, int]" = {}
         for rid in range(int(replicas)):
             cache_budget = devcache_budget_bytes
             cache = _devcache.DeviceOperandCache(
                 budget_bytes=cache_budget, namespace=f"r{rid}")
+            # The replica's verdict memo store companions ITS devcache:
+            # a tenant rotation (or epoch bump) on the replica's
+            # residency namespace stales exactly that replica's
+            # memoized verdicts.  Affinity keeps recurring content on
+            # one home, so the home's memo store — like its residency —
+            # runs hot, and a spillover/failover re-issue consults the
+            # PEER's own store through the peer service's submit path.
+            vcache = _verdictcache.VerdictCache(
+                namespace=f"r{rid}", companion=cache)
             svc = self._factory(rid, self._clock, cache)
-            self.replicas[rid] = Replica(rid, svc, cache)
+            svc.verdict_cache = vcache
+            self.replicas[rid] = Replica(rid, svc, cache, vcache)
             self._tracked[rid] = {}
 
     def _default_factory(self, rid: int, clock, cache):
@@ -315,6 +342,51 @@ class ReplicaSet:
         digest = self._digest_of(v)
         tenant_name = tenant if tenant is not None \
             else _tenancy.DEFAULT_TENANT
+        # FRONT-DOOR DEDUP (round 12): an identical concurrent
+        # submission — byte-identical queue stream (content_digest),
+        # same class and tenant, therefore the same affinity home —
+        # that is still in flight shares that submission's federated
+        # ticket instead of occupying a second queue slot.  Bit-
+        # identical by construction (every sharer reads the one
+        # ladder-decided bool); deadline discipline: share ONLY when
+        # the deadlines are EQUAL (both None, or the same absolute
+        # time) — sharing a ticket shares its FAILURE outcomes too,
+        # and a tighter in-flight deadline could shed with
+        # DeadlineExceeded where this submission, on its own, would
+        # have earned a verdict.  Equal deadlines shed identically,
+        # so nothing is inherited that was not also owed.  A None
+        # digest never dedups.
+        content = v.content_digest()
+        if content is not None:
+            key = (content, cls, tenant_name)
+            with self._lock:
+                ent = self._front_dedup.get(key)
+                if ent is not None:
+                    fed0, dl0, rid0, hit0 = ent
+                    if fed0.done():
+                        # Opportunistic shed (maintain() prunes too,
+                        # but a submit that OBSERVES a resolved entry
+                        # must not leave it pinning the ticket): a
+                        # resolved duplicate is the verdict cache's
+                        # business now, not dedup's.
+                        del self._front_dedup[key]
+                        ent = None
+                if ent is not None:
+                    if dl0 == deadline:
+                        self.totals["submitted"] += 1
+                        self.totals["dedup_fanout"] += 1
+                        # The shared ticket's PLACEMENT outcome is this
+                        # submission's too: a deduped submission rides
+                        # the original's replica, so the affinity
+                        # surface must count it the same way or
+                        # affinity_hit_rate deflates exactly when
+                        # dedup works best.
+                        self.totals["affinity_hits"
+                                    if hit0 else "spillovers"] += 1
+                        self._dedup_by_replica[rid0] = \
+                            self._dedup_by_replica.get(rid0, 0) + 1
+                        _metrics.record_fault("federation_dedup_fanout")
+                        return fed0
         candidates, first = self._candidates(digest, tenant_name, cls)
         self.totals["submitted"] += 1
         last_exc = None
@@ -322,7 +394,8 @@ class ReplicaSet:
             rep = self.replicas[rid]
             try:
                 ticket = rep.service.submit(
-                    v, deadline=deadline, cls=cls, tenant=tenant)
+                    v, deadline=deadline, cls=cls, tenant=tenant,
+                    _content_digest=content)
             except _service.Overloaded as exc:
                 last_exc = exc
                 continue
@@ -331,6 +404,17 @@ class ReplicaSet:
             with self._lock:
                 self._tracked[rid][id(ticket)] = (fed, v, deadline,
                                                   cls, tenant_name)
+                if content is not None:
+                    # Never displace a still-in-flight ledger entry: a
+                    # different-deadline duplicate placed separately
+                    # must not evict the original's entry, or later
+                    # duplicates matching the ORIGINAL's deadline lose
+                    # the dedup the feature exists for.
+                    cur = self._front_dedup.get(
+                        (content, cls, tenant_name))
+                    if cur is None or cur[0].done():
+                        self._front_dedup[(content, cls, tenant_name)] \
+                            = (fed, deadline, rid, rid == first)
             # Ejection race: between the candidate check and the
             # enqueue above, the dispatcher thread may have ejected
             # this replica — its surrender sweep ran BEFORE our
@@ -419,6 +503,12 @@ class ReplicaSet:
         _metrics.record_fault("replica_ejected")
         rep.crashed = rep.crashed or crashed
         rep.cache.drop_all(f"replica-ejected: {reason}")
+        # The memo store dies with the replica: in a real deployment
+        # it is the dead process's host memory, and re-issue is
+        # re-verification — never verdict transfer — so the peers owe
+        # nothing to (and must inherit nothing from) this store.
+        if rep.vcache is not None:
+            rep.vcache.drop_all(f"replica-ejected: {reason}")
         self._sweep_ejected(rep)
 
     def _sweep_ejected(self, rep: Replica) -> None:
@@ -516,9 +606,18 @@ class ReplicaSet:
     def maintain(self) -> None:
         """The non-wave ladder work: drained-empty replicas eject,
         probation replicas get their host-verified probes (revival
-        included)."""
+        included), and the front-door dedup ledger sheds resolved
+        entries."""
         self._advance_drains()
         self._run_probes()
+        self._prune_front_dedup()
+
+    def _prune_front_dedup(self) -> None:
+        with self._lock:
+            done = [k for k, ent in self._front_dedup.items()
+                    if ent[0].done()]
+            for k in done:
+                del self._front_dedup[k]
 
     def process_once(self) -> int:
         """One federation dispatcher iteration: pump every placed (or
@@ -574,6 +673,10 @@ class ReplicaSet:
                 # would strand that ticket forever.
                 self._sweep_ejected(rep)
                 rep.service = self._factory(rid, self._clock, rep.cache)
+                # Same namespaced memo store object (already dropped at
+                # ejection): the revived replica re-warms from traffic,
+                # exactly like its residency.
+                rep.service.verdict_cache = rep.vcache
                 rep.crashed = False
                 rep.degraded_frac = None
                 self.totals["revivals"] += 1
@@ -633,6 +736,18 @@ class ReplicaSet:
                     "namespace": rep.cache.namespace,
                     "resident_keysets": rep.cache.resident_count(),
                 },
+                "verdictcache": {
+                    "namespace": (rep.vcache.namespace
+                                  if rep.vcache is not None else None),
+                    "resident_verdicts": (
+                        rep.vcache.resident_count()
+                        if rep.vcache is not None else 0),
+                    "hits": st.get("verdict_cache_hits", 0),
+                    "stores": st.get("verdict_cache_stores", 0),
+                },
+                # Front-door dedup fanned out onto this replica's
+                # in-flight ticket (the fleet_slo surface).
+                "dedup_fanout": self._dedup_by_replica.get(rid, 0),
                 "crashed": rep.crashed,
                 "pumps": rep.pumps,
             }

@@ -228,9 +228,12 @@ _latch_lock = threading.Lock()
 # abandoned mid-call may leave device-resident operand arrays behind on
 # a runtime whose state is no longer trusted, so `mark_lane_stuck` —
 # the one canonical lane-death/abandonment transition — notifies every
-# registered listener (devcache registers its drop_all).  Listeners run
-# OUTSIDE any DeviceHealth lock (module contract: no method calls out
-# of the module while holding a lock) and must not raise.  The list is
+# registered listener (devcache registers its drop_all; since round 12
+# verdictcache registers an epoch bump too — memoized verdicts decided
+# while a now-distrusted device participated are conservatively
+# forfeited and re-decided on demand).  Listeners run OUTSIDE any
+# DeviceHealth lock (module contract: no method calls out of the
+# module while holding a lock) and must not raise.  The list is
 # append-only process wiring, not cache state (CL004-reviewed).
 _residency_listeners = []
 

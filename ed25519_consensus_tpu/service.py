@@ -241,17 +241,27 @@ class VerifyTicket:
 
 class _Request:
     __slots__ = ("verifier", "deadline", "ticket", "sigs", "cls",
-                 "tenant")
+                 "tenant", "memo_digest", "memo_pins")
 
     def __init__(self, verifier, deadline, sigs,
                  cls=_tenancy.CLASS_MEMPOOL,
-                 tenant=_tenancy.DEFAULT_TENANT):
+                 tenant=_tenancy.DEFAULT_TENANT,
+                 memo_digest=None, memo_pins=None):
         self.verifier = verifier
         self.deadline = deadline  # absolute service-clock time or None
         self.ticket = VerifyTicket()
         self.sigs = sigs
         self.cls = cls
         self.tenant = tenant
+        # The content digest and epoch-pin tuple the submission was
+        # ADMITTED under (None = no live digest, or the verdict cache
+        # was off at admission): the post-wave memo store re-derives
+        # the payload and refuses to write under a digest the bytes no
+        # longer hash to, OR under an epoch regime that moved while
+        # the request was in flight (a mid-wave invalidation/rotation
+        # exists precisely to forfeit these decisions).
+        self.memo_digest = memo_digest
+        self.memo_pins = memo_pins
 
 
 class _HostOnlyHealth(_health.DeviceHealth):
@@ -300,6 +310,16 @@ class VerifyService:
       injected per-replica DeviceOperandCache for tenant assignment
       (a ReplicaSet namespaces residency per replica).  Both are
       placement state, never verdict inputs.
+    * verdict_cache — an injected verdictcache.VerdictCache (round 12;
+      None = the process default, resolved live).  Consulted at
+      SUBMIT, pre-coalescing: a re-hashed hit resolves the ticket
+      immediately — no queue occupancy, no watermark pressure, no
+      device work — and the post-wave write path memoizes each
+      ladder-decided verdict for the next byte-identical submission
+      (the mempool→consensus double-verify).  Structurally off the
+      verdict math path: a hit replays a bit-identical past decision
+      on bit-identical bytes (consensuslint CL007 +
+      docs/consensus-invariants.md).
 
     Thread semantics: `submit` is callable from any number of threads;
     one dispatcher (thread or `process_once` caller) executes waves —
@@ -324,7 +344,7 @@ class VerifyService:
                  device_time_prior: float = 2.0,
                  rng=None, auto_start: bool = True,
                  replica_id: "str | None" = None,
-                 cache=None):
+                 cache=None, verdict_cache=None):
         # Per-class admission policy (tenancy.py): mempool keeps the
         # (high, low) watermark pair — the exact pre-tenancy admission
         # semantics and the class `submit()` defaults to — rpc sheds
@@ -359,6 +379,11 @@ class VerifyService:
         # placement/observability state, never verdict inputs.
         self.replica_id = replica_id
         self.cache = cache
+        # Verdict memoization (round 12): the injected cross-wave
+        # verdict cache instance (None = process default, resolved
+        # live so tests and knob flips take effect).  A ReplicaSet
+        # overwrites this with the replica's namespaced instance.
+        self.verdict_cache = verdict_cache
 
         self._cv = threading.Condition()
         # One FIFO queue per traffic class, drained in CLASSES priority
@@ -389,6 +414,12 @@ class VerifyService:
             # the mempool→consensus double-verify collapsing inside
             # one dispatcher wave.
             "dedup_fanout": 0,
+            # Cross-wave verdict memoization (round 12, the other half
+            # of ROADMAP item 5): submissions resolved at the front
+            # door from a re-hashed memoized verdict (no queue
+            # occupancy, no device work), and ladder-decided verdicts
+            # written to the memo store after their wave.
+            "verdict_cache_hits": 0, "verdict_cache_stores": 0,
         }
         # Per-class lifecycle tallies (the fairness surface the traffic
         # lab and the SLO gates read): every submission lands in
@@ -464,7 +495,8 @@ class VerifyService:
     def submit(self, entries, deadline: "float | None" = None,
                timeout: "float | None" = None,
                cls: "str | None" = None,
-               tenant: "str | None" = None) -> VerifyTicket:
+               tenant: "str | None" = None,
+               _content_digest: "bytes | None" = None) -> VerifyTicket:
         """Submit one batch: a `batch.Verifier` (ownership transfers to
         the service — do not mutate or verify it afterwards) or an
         iterable of `(vk_bytes, sig, msg)` entries.  `deadline` is an
@@ -477,6 +509,12 @@ class VerifyService:
         verdict.  `tenant` tags the batch's recurring keyset for the
         device operand cache's per-tenant residency quotas (cache
         QoS); it too is purely a resource-placement hint.
+        `_content_digest` (private) lets a front door that ALREADY
+        hashed the batch (federation's dedup ledger) hand the digest
+        down instead of paying a second full-payload SHA-256 here; it
+        must equal `entries.content_digest()` at the moment of the
+        call, which the federation caller guarantees by computing it
+        on the same untouched verifier.
 
         Returns a `VerifyTicket`; raises `Overloaded` when the bounded
         queue cannot admit the batch (beyond capacity, or the class is
@@ -494,9 +532,45 @@ class VerifyService:
         if timeout is not None:
             t = self.now() + float(timeout)
             deadline = t if deadline is None else min(deadline, t)
-        req = _Request(v, deadline, v.batch_size, cls=cls,
-                       tenant=tenant if tenant is not None
+        # Verdict memoization, PRE-coalescing (round 12): a submission
+        # whose content digest finds a re-hashed memo resolves RIGHT
+        # HERE — it never occupies the queue, never moves a watermark,
+        # never reaches a wave.  The served verdict is a bit-identical
+        # past decision of the full ladder on bit-identical bytes
+        # (verdictcache.py's per-hit re-hash is unconditional — the
+        # consensus-class serve rule holds for every class); a miss,
+        # a None digest, or a disabled cache all fall through to the
+        # normal admission path — full verification is the default.
+        memo_digest = None
+        memo_pins = None
+        tenant_name = (tenant if tenant is not None
                        else _tenancy.DEFAULT_TENANT)
+        vc = self._verdict_cache()
+        if vc is not None:
+            memo_digest = (_content_digest if _content_digest is not None
+                           else v.content_digest())
+            if memo_digest is not None:
+                hit = vc.lookup(memo_digest, tenant=tenant_name)
+                if hit is not None:
+                    with self._cv:
+                        if self._closed:
+                            raise ServiceClosed()
+                        self.totals["submitted"] += 1
+                        self.by_class[cls]["submitted"] += 1
+                        self.totals["verdict_cache_hits"] += 1
+                        self.totals["resolved"] += 1
+                        self.by_class[cls]["resolved"] += 1
+                    _metrics.record_fault("service_verdict_cache_hit")
+                    ticket = VerifyTicket()
+                    ticket._resolve(hit.verdict)
+                    return ticket
+                # Miss: capture the epoch regime this request will be
+                # DECIDED under — the store refuses if it moves while
+                # the request is in flight.
+                memo_pins = vc.epoch_pins(tenant_name)
+        req = _Request(v, deadline, v.batch_size, cls=cls,
+                       tenant=tenant_name,
+                       memo_digest=memo_digest, memo_pins=memo_pins)
         # Tenant assignment happens BEFORE enqueue: the verifier is
         # still private here (after append the dispatcher may be
         # staging it concurrently), and the partition must be on
@@ -566,6 +640,17 @@ class VerifyService:
         blob = verifier._canonical_keyset_blob()
         if blob:
             cache.assign_tenant(_devcache.keyset_digest(blob), tenant)
+
+    def _verdict_cache(self):
+        """The live verdict-cache instance (injected, else the process
+        default), or None when memoization is disabled — submit's hit
+        path and process_once's store path both resolve through here so
+        knob flips and test injection take effect immediately."""
+        from . import verdictcache as _verdictcache
+
+        vc = (self.verdict_cache if self.verdict_cache is not None
+              else _verdictcache.default_cache())
+        return vc if vc.enabled else None
 
     def _set_shedding(self, cls: str, flag: bool) -> None:
         # under self._cv
@@ -675,7 +760,40 @@ class VerifyService:
             if probe:
                 self.totals["probe_waves"] += 1
             self._execute(routable, device=True, probe=probe)
+        # Verdict memoization, the WRITE path (round 12): runs AFTER
+        # the wave's verdict aggregation returned and every ticket is
+        # sealed — structurally outside the verdict path (consensuslint
+        # CL007: nothing reachable from _execute's aggregation writes
+        # cache state as a side effect of deciding).
+        self._store_verdicts(live)
         return resolved + len(live)
+
+    def _store_verdicts(self, reqs) -> None:
+        """Memoize each ladder-decided verdict of a completed wave.
+        Pure bookkeeping over ALREADY-resolved tickets — by the time
+        this runs, every waiter could have read its verdict; nothing
+        here can change one.  The store itself re-derives the content
+        payload and refuses to write when it no longer hashes to the
+        admission-time digest (verdictcache.store), so an invalidate()
+        or map exposure that landed mid-flight memoizes nothing."""
+        vc = self._verdict_cache()
+        if vc is None:
+            return
+        stored = 0
+        for req in reqs:
+            t = req.ticket
+            if req.memo_digest is None or not t.done() \
+                    or t._outcome != "ok":
+                continue
+            if vc.store(req.verifier, t._value, cls=req.cls,
+                        tenant=req.tenant if req.tenant is not None
+                        else _tenancy.DEFAULT_TENANT,
+                        expected_digest=req.memo_digest,
+                        expected_pins=req.memo_pins):
+                stored += 1
+        if stored:
+            with self._cv:
+                self.totals["verdict_cache_stores"] += stored
 
     def _execute(self, reqs, device: bool, probe: bool) -> None:
         """Run one routed group through verify_many under supervision:

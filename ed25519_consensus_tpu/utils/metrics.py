@@ -61,7 +61,13 @@ def reset_fault_counters() -> None:
 # "devcache_restages" / "devcache_epoch" — plus the event counters
 # "devcache_restage_hash_mismatch", "devcache_stale_epoch",
 # "devcache_evict", and "devcache_drop_all" in the fault registry
-# above.  Same process-wide registry discipline as the counters.
+# above.  The verdict cache (verdictcache.py, round 12) publishes the
+# same family under "verdictcache_*" ("verdictcache_hits" /
+# "verdictcache_misses" / "verdictcache_stores" /
+# "verdictcache_rehash_mismatch" / "verdictcache_resident_bytes" and
+# friends; namespaced per-replica instances prefix
+# "verdictcache_<ns>_*").  Same process-wide registry discipline as
+# the counters.
 
 _gauge_lock = threading.Lock()
 _gauges: dict = {}

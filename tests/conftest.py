@@ -128,6 +128,21 @@ def pytest_sessionfinish(session, exitstatus):
               file=sys.stderr)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_verdict_cache_per_test():
+    """Reset the process-default verdict cache around every test: the
+    memo store is CONTENT-addressed, and the suites deliberately reuse
+    deterministic keys/messages across tests — a verdict memoized by
+    one test would short-circuit another test's queue/wave assertions
+    (the served verdict would still be bit-correct; the dynamics under
+    test would not be).  Cheap: the default rebuilds lazily."""
+    from ed25519_consensus_tpu import verdictcache
+
+    verdictcache.set_default_cache(None)
+    yield
+    verdictcache.set_default_cache(None)
+
+
 @pytest.fixture(autouse=True, scope="session")
 def _lock_order_audit_at_session_end():
     """With ED25519_TPU_LOCK_AUDIT=1: check the recorded lock

@@ -290,9 +290,11 @@ def test_real_tenancy_and_traffic_lab_lint_clean():
 
     paths = [
         os.path.join(linter.PACKAGE_ROOT, "tenancy.py"),
+        os.path.join(linter.PACKAGE_ROOT, "verdictcache.py"),
         os.path.join(linter.REPO_ROOT, "tools", "traffic_lab.py"),
         os.path.join(linter.REPO_ROOT, "tools", "mesh_chaos.py"),
         os.path.join(linter.REPO_ROOT, "tools", "sentinel_soak.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "replay_lab.py"),
     ]
     findings = linter.lint_paths(paths)
     assert findings == [], [str(f) for f in findings]
@@ -346,6 +348,122 @@ def test_real_federation_lints_clean_under_committed_waivers():
     assert active == [], [str(f) for f in active]
     assert {f.symbol for f in findings} == {
         "ReplicaSet._supervised", "ReplicaSet._reissue"}
+
+
+# -- CL007: verdict-cache write-path discipline (round 12) -----------------
+# The verdict memo store is READ-ONLY on the verdict path: stores
+# belong to the post-wave bookkeeping (process_once), never to verdict
+# aggregation, and the only sanctioned entry read is through lookup()
+# — the symbol that owns the per-hit byte-for-byte re-hash.
+
+
+def test_cl007_negative_store_inside_execute():
+    src = ("class VerifyService:\n"
+           "    def _execute(self, reqs, device, probe):\n"
+           "        verdicts = run(reqs)\n"
+           "        for req, verdict in zip(reqs, verdicts):\n"
+           "            self.verdict_cache.store(req.verifier, verdict)\n"
+           "        return verdicts\n")
+    findings = lint_fixture("service.py", src)
+    assert "CL007" in rules_of(findings)
+    assert any("read-only" in f.message for f in findings)
+
+
+def test_cl007_negative_store_inside_verify_many():
+    src = ("def verify_many(vs, cache):\n"
+           "    verdicts = [decide(v) for v in vs]\n"
+           "    for v, verdict in zip(vs, verdicts):\n"
+           "        cache.store(v, verdict)\n"
+           "    return verdicts\n")
+    assert rules_of(lint_fixture("batch.py", src)) == ["CL007"]
+
+
+def test_cl007_negative_raw_entry_read_bypasses_rehash():
+    src = ("def serve(vcache, d):\n"
+           "    entry = vcache._entries[d]\n"
+           "    return entry.verdict\n")
+    findings = lint_fixture("service.py", src)
+    assert rules_of(findings) == ["CL007"]
+    assert "re-hash" in findings[0].message
+
+
+def test_cl007_positive_store_in_process_once_lookup_in_submit():
+    """The shipped shape: stores AFTER _execute returns (process_once
+    bookkeeping), reads only through lookup() — clean."""
+    src = ("class VerifyService:\n"
+           "    def process_once(self):\n"
+           "        reqs = self._take_wave(False)\n"
+           "        self._execute(reqs, False, False)\n"
+           "        self._store_verdicts(reqs)\n"
+           "    def _store_verdicts(self, reqs):\n"
+           "        for req in reqs:\n"
+           "            self.verdict_cache.store(req.verifier, True)\n"
+           "    def submit(self, v):\n"
+           "        hit = self.verdict_cache.lookup(v.content_digest())\n"
+           "        return hit.verdict if hit is not None else None\n")
+    assert lint_fixture("service.py", src) == []
+
+
+def test_cl007_positive_verdictcache_owns_its_internals():
+    src = ("class VerdictCache:\n"
+           "    def _lookup_locked(self, digest):\n"
+           "        return self._entries.get(digest)\n"
+           "    def lookup(self, digest):\n"
+           "        e = self._lookup_locked(digest)\n"
+           "        return e if e is not None and e.recheck() else None\n")
+    assert lint_fixture("verdictcache.py", src) == []
+
+
+def test_cl007_out_of_scope_module_untouched():
+    # routing.py is not a module that can reach the verdict cache
+    src = ("def f(cache, v):\n"
+           "    cache.store(v, True)\n")
+    assert lint_fixture("routing.py", src) == []
+
+
+def test_cl007_replay_lab_in_scope():
+    src = ("def verify_many(vs, memo_store):\n"
+           "    verdicts = [decide(v) for v in vs]\n"
+           "    memo_store.put(vs[0], verdicts[0])\n"
+           "    return verdicts\n")
+    assert rules_of(lint_tool_fixture("tools/replay_lab.py",
+                                      src)) == ["CL007"]
+
+
+def test_cl004_negative_verdictcache_module_global_store():
+    """The old-batch.py-cache shape rejected in verdictcache.py too:
+    the memo store is an injectable object behind the allowlisted
+    `_default` slot, never ambient module state."""
+    findings = lint_fixture("verdictcache.py", "_verdict_store = {}\n")
+    assert rules_of(findings) == ["CL004"]
+    assert "_verdict_store" in findings[0].message
+
+
+def test_cl006_negative_verdictcache_overbroad_except():
+    src = ("def lookup(d):\n"
+           "    try:\n"
+           "        return fetch(d)\n"
+           "    except Exception:\n"
+           "        return None\n")
+    assert rules_of(lint_fixture("verdictcache.py", src)) == ["CL006"]
+
+
+def test_real_service_and_verdictcache_hold_cl007():
+    """The HEAD gate for the new rule, file by file: the shipped
+    service/batch/federation/verdictcache tree has NO CL007 findings
+    at all (no waivers needed — the ratchet stays at 8)."""
+    import os
+
+    paths = [
+        os.path.join(linter.PACKAGE_ROOT, "batch.py"),
+        os.path.join(linter.PACKAGE_ROOT, "service.py"),
+        os.path.join(linter.PACKAGE_ROOT, "federation.py"),
+        os.path.join(linter.PACKAGE_ROOT, "verdictcache.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "replay_lab.py"),
+    ]
+    findings = [f for f in linter.lint_paths(paths)
+                if f.rule == "CL007"]
+    assert findings == [], [str(f) for f in findings]
 
 
 # -- CL005: secret hygiene -------------------------------------------------
@@ -726,15 +844,14 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 38 knobs (31 through the
-    round-10 self-diagnosing-mesh work + the seven round-11 federation
-    knobs: replica suspicion threshold/half-life, probe length,
-    spillover opt-out, degraded fraction, the fleet-lab seed, and the
-    devcache quota auto-size opt-in)."""
+    these rows) and the registry knows all 42 knobs (38 through the
+    round-11 federation work + the four round-12 verdict-memoization
+    knobs: the verdict-cache enable opt-out, its byte budget, its
+    per-tenant quota, and the replay-lab seed)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 38
+    assert len(rows) == len(config.KNOBS) == 42
     assert all(doc for (_, _, _, doc) in rows)
     for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
                  "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
@@ -757,7 +874,11 @@ def test_config_registry_covers_readme_table():
                  "ED25519_TPU_REPLICA_SPILLOVER",
                  "ED25519_TPU_REPLICA_DEGRADED_FRAC",
                  "ED25519_TPU_FLEET_LAB_SEED",
-                 "ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE"):
+                 "ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE",
+                 "ED25519_TPU_VERDICT_CACHE_ENABLED",
+                 "ED25519_TPU_VERDICT_CACHE_BYTES",
+                 "ED25519_TPU_VERDICT_CACHE_TENANT_QUOTA",
+                 "ED25519_TPU_REPLAY_LAB_SEED"):
         assert name in config.KNOBS
 
 

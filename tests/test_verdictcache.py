@@ -1,0 +1,825 @@
+"""The content-addressed verdict cache (verdictcache.py, round 12):
+the mempool→consensus double-verify memo.
+
+The property under test is the ISSUE-14 claim: memoization buys
+throughput, never verdicts — a hit replays a bit-identical past
+decision on bit-identical bytes (the per-hit byte-for-byte re-hash is
+unconditional), any mismatch degrades to full verification, and
+nothing reachable from verdict aggregation ever writes the store
+(consensuslint CL007 pins the syntax, the CorruptStoredVerdict fault
+pins the semantics).  tools/replay_lab.py drives the full seeded
+mempool→block→vote-replay scenario in CI; everything here is the
+deterministic unit/integration scale."""
+
+import random
+
+import pytest
+
+from ed25519_consensus_tpu import (
+    Signature,
+    SigningKey,
+    VerificationKeyBytes,
+    batch,
+    devcache,
+    faults,
+    federation,
+    health,
+    service,
+    tenancy,
+    verdictcache,
+)
+
+rng = random.Random(0x3E6D0)
+
+
+@pytest.fixture(autouse=True)
+def host_only(monkeypatch):
+    # The memo layer sits entirely above routing: host-only keeps the
+    # suite deterministic and device-free (the mesh-path replay of the
+    # ZIP215 matrix below clears this itself).
+    monkeypatch.setenv("ED25519_TPU_DISABLE_DEVICE", "1")
+    yield
+    if faults.active_plan():
+        faults.uninstall()
+    devcache.set_default_cache(None)
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+
+
+KEYS = [SigningKey.new(random.Random(0x3E6D1 + i)) for i in range(4)]
+
+
+def entries_for(tag: bytes, n: int = 2, bad: bool = False):
+    out = []
+    for i in range(n):
+        sk = KEYS[i % len(KEYS)]
+        msg = b"vc-%s-%d" % (tag, i)
+        sig = sk.sign(msg)
+        if bad and i == 0:
+            msg += b"!"
+        out.append((sk.verification_key_bytes(), sig, msg))
+    return out
+
+
+def verifier_for(tag: bytes, n: int = 2, bad: bool = False):
+    v = batch.Verifier()
+    v.queue_bulk(entries_for(tag, n=n, bad=bad))
+    return v
+
+
+def make_cache(**kw):
+    kw.setdefault("budget_bytes", 1 << 20)
+    kw.setdefault("enabled", True)
+    kw.setdefault("tenant_quota_bytes", 0)
+    return verdictcache.VerdictCache(**kw)
+
+
+def make_service(**kw):
+    fc = health.FakeClock()
+    kw.setdefault("auto_start", False)
+    kw.setdefault("clock", fc)
+    kw.setdefault("verdict_cache", make_cache())
+    return service.VerifyService(**kw), fc
+
+
+# -- the store/lookup contract ---------------------------------------------
+
+
+def test_store_and_lookup_roundtrip_both_verdicts():
+    vc = make_cache()
+    for tag, verdict in ((b"t", True), (b"f", False)):
+        v = verifier_for(tag, bad=not verdict)
+        assert vc.store(v, verdict) is True
+        hit = vc.lookup(v.content_digest())
+        assert hit is not None and hit.verdict is verdict
+    st = vc.stats()
+    assert st["stores"] == 2 and st["hits"] == 2
+
+
+def test_store_is_idempotent_and_lookup_counts_misses():
+    vc = make_cache()
+    v = verifier_for(b"idem")
+    assert vc.lookup(v.content_digest()) is None
+    assert vc.store(v, True) is True
+    assert vc.store(v.clone(), True) is False  # refresh, not a store
+    assert vc.stats()["stores"] == 1
+    assert vc.stats()["misses"] == 1
+
+
+def test_lookup_none_digest_always_bypasses():
+    vc = make_cache()
+    assert vc.lookup(None) is None
+    assert vc.stats()["hits"] == 0 and vc.stats()["misses"] == 0
+
+
+def test_store_refuses_exposed_map_and_invalidated_batches():
+    """The write-side trust discipline: content that cannot vouch for
+    itself (None payload) is never memoized."""
+    vc = make_cache()
+    v = verifier_for(b"exp")
+    _ = v.signatures  # exposure retires the buffers
+    assert vc.store(v, True) is False
+    v2 = verifier_for(b"inv")
+    v2.invalidate("out of band")
+    assert vc.store(v2, False) is False
+    assert vc.stats()["stores"] == 0
+
+
+def test_store_refuses_drifted_payload_via_expected_digest():
+    vc = make_cache()
+    v = verifier_for(b"drift")
+    admitted = v.content_digest()
+    v.queue(entries_for(b"late", n=1)[0])  # bytes changed since admission
+    assert vc.store(v, True, expected_digest=admitted) is False
+    assert vc.store(v, True) is True  # under its CURRENT digest it may
+
+
+# -- the re-hash guard (the consensus gate) --------------------------------
+
+
+def test_flipped_stored_verdict_is_caught_by_the_seal():
+    """A stored accept/reject bit that rots must NEVER be served: the
+    seal re-derivation fails, the entry drops, the lookup is a miss."""
+    vc = make_cache()
+    v = verifier_for(b"seal")
+    vc.store(v, True)
+    d = v.content_digest()
+    # reach the raw entry the way only this test may: flip the bit
+    entry = vc.lookup(d)
+    assert entry is not None
+    entry.verdict = False
+    assert vc.lookup(d) is None
+    assert vc.counters["rehash_mismatch"] == 1
+    assert vc.lookup(d) is None  # dropped, stays a plain miss
+
+
+def test_corrupted_payload_is_caught_by_the_digest_rehash():
+    vc = make_cache()
+    v = verifier_for(b"rot")
+    vc.store(v, False)
+    d = v.content_digest()
+    entry = vc.lookup(d)
+    b_ = bytearray(entry.payload)
+    b_[7] ^= 0x20
+    entry.payload = bytes(b_)
+    assert vc.lookup(d) is None
+    assert vc.counters["rehash_mismatch"] == 1
+
+
+def test_corrupt_stored_verdict_fault_never_publishes():
+    """End to end through the service: the CorruptStoredVerdict fault
+    flips every hit's stored verdict — the re-hash must catch each one
+    and the submission must fully re-verify to the true verdict."""
+    svc, fc = make_service()
+    good = svc.submit(entries_for(b"cf-good"))
+    bad = svc.submit(entries_for(b"cf-bad", bad=True))
+    svc.process_once()
+    assert good.result(5) is True and bad.result(5) is False
+    plan = faults.verdictcache_plan(0xC0, "corrupt-verdict",
+                                    at=0, length=4096)
+    with faults.injected(plan):
+        g2 = svc.submit(entries_for(b"cf-good"))
+        b2 = svc.submit(entries_for(b"cf-bad", bad=True))
+        assert not g2.done() and not b2.done()  # degraded to full verify
+        svc.process_once()
+        assert g2.result(5) is True
+        assert b2.result(5) is False
+    vc = svc.verdict_cache
+    assert vc.counters["rehash_mismatch"] == 2
+    assert svc.totals["verdict_cache_hits"] == 0
+    assert plan.injection_log(), "the fault must actually have fired"
+    svc.close()
+
+
+def test_verdictcache_fault_plans_replay_identically():
+    plans = [faults.verdictcache_plan(7, "corrupt-verdict", at=1,
+                                      length=3) for _ in range(2)]
+    logs = []
+    for plan in plans:
+        vc = make_cache()
+        v = verifier_for(b"det")
+        vc.store(v, True)
+        with faults.injected(plan):
+            for _ in range(6):
+                vc.lookup(v.content_digest())
+        logs.append(plan.injection_log())
+    assert logs[0] == logs[1] and logs[0]
+
+
+# -- epochs and rotation ---------------------------------------------------
+
+
+def test_global_epoch_bump_stales_every_entry():
+    vc = make_cache()
+    v = verifier_for(b"ep")
+    vc.store(v, True)
+    vc.bump_epoch("test")
+    assert vc.lookup(v.content_digest()) is None
+    assert vc.counters["stale_epoch"] == 1
+
+
+def test_rotate_tenant_stales_exactly_that_tenant():
+    vc = make_cache()
+    va, vb = verifier_for(b"ra"), verifier_for(b"rb")
+    vc.store(va, True, tenant="chain-a")
+    vc.store(vb, True, tenant="chain-b")
+    vc.rotate_tenant("chain-a")
+    assert vc.lookup(va.content_digest(), tenant="chain-a") is None
+    hit = vc.lookup(vb.content_digest(), tenant="chain-b")  # untouched
+    assert hit is not None and hit.tenant == "chain-b"
+
+
+def test_companion_devcache_rotation_and_epoch_wire_through():
+    """The devcache wiring: `devcache.rotate_tenant()` and
+    `devcache.bump_epoch()` (what `Verifier.invalidate()` drives)
+    stale the companioned verdict entries with no listener plumbing."""
+    devc = devcache.DeviceOperandCache(budget_bytes=1 << 16,
+                                       enabled=False)
+    vc = make_cache(companion=devc)
+    va, vb = verifier_for(b"ca"), verifier_for(b"cb")
+    vc.store(va, True, tenant="chain-a")
+    vc.store(vb, False, tenant="chain-b")
+    devc.rotate_tenant("chain-a")
+    assert vc.lookup(va.content_digest(), tenant="chain-a") is None
+    assert vc.lookup(vb.content_digest(), tenant="chain-b") is not None
+    devc.bump_epoch("invalidate")
+    assert vc.lookup(vb.content_digest(), tenant="chain-b") is None
+
+
+def test_verifier_invalidate_stales_default_memo_store():
+    """End to end: `Verifier.invalidate()` bumps the default devcache
+    epoch, which the DEFAULT verdict cache companions — a memoized
+    verdict decided before an out-of-band invalidation is never
+    replayed after it."""
+    verdictcache.set_default_cache(None)
+    svc = service.VerifyService(auto_start=False,
+                                clock=health.FakeClock(),
+                                verdict_cache=None)
+    t1 = svc.submit(entries_for(b"invw"))
+    svc.process_once()
+    assert t1.result(5) is True
+    t2 = svc.submit(entries_for(b"invw"))
+    assert t2.done(), "sanity: the memo serves before the invalidate"
+    other = verifier_for(b"other")
+    other.invalidate("distrust")
+    t3 = svc.submit(entries_for(b"invw"))
+    assert not t3.done(), "post-invalidate the memo must be stale"
+    svc.process_once()
+    assert t3.result(5) is True
+    svc.close()
+    verdictcache.set_default_cache(None)
+
+
+def test_mid_flight_epoch_bump_refuses_the_store():
+    """The review-hardened forfeiture rule: an epoch bump landing
+    while a request is IN FLIGHT (admitted, not yet decided) must
+    forfeit that request's verdict from the memo — the store refuses
+    under moved pins, and the next identical submission re-verifies."""
+    svc, fc = make_service()
+    t1 = svc.submit(entries_for(b"mfb"))
+    svc.verdict_cache.bump_epoch("mid-flight distrust")
+    svc.process_once()
+    assert t1.result(5) is True  # the verdict itself is unaffected
+    assert svc.totals["verdict_cache_stores"] == 0
+    t2 = svc.submit(entries_for(b"mfb"))
+    assert not t2.done(), "the forfeited verdict must not be served"
+    svc.process_once()
+    assert t2.result(5) is True
+    # decided entirely under the new regime: now it memoizes
+    assert svc.totals["verdict_cache_stores"] == 1
+    svc.close()
+
+
+def test_store_refuses_moved_pins_directly():
+    vc = make_cache()
+    v = verifier_for(b"pins")
+    pins = vc.epoch_pins("t")
+    vc.rotate_tenant("t")
+    assert vc.store(v, True, tenant="t", expected_pins=pins) is False
+    assert vc.store(v, True, tenant="t",
+                    expected_pins=vc.epoch_pins("t")) is True
+
+
+def test_misses_attribute_to_the_submitting_tenant():
+    """The quota-sizing input: a miss-heavy tenant must tally as
+    itself (lookup carries the submitting tenant), not as the default
+    partition — suggest_tenant_quotas reads these weights."""
+    vc = make_cache()
+    d = verifier_for(b"attr").content_digest()
+    for _ in range(3):
+        vc.lookup(d, tenant="chain-b")
+    ts = vc.tenant_stats()
+    assert ts["chain-b"]["misses"] == 3
+    assert ts.get("default", {}).get("misses", 0) == 0
+
+
+# -- budget, LRU, tenant quotas --------------------------------------------
+
+
+def _payload_nbytes(tag: bytes) -> int:
+    v = verifier_for(tag)
+    return len(v.content_payload()) + 96  # _ENTRY_OVERHEAD
+
+
+def test_lru_eviction_is_deterministic_and_budgeted():
+    one = _payload_nbytes(b"z0")
+    vc = make_cache(budget_bytes=2 * one)
+    vs = [verifier_for(b"z%d" % i) for i in range(3)]
+    for v in vs[:2]:
+        vc.store(v, True)
+    vc.lookup(vs[0].content_digest())  # refresh 0: victim becomes 1
+    vc.store(vs[2], True)
+    assert vc.counters["evictions"] == 1
+    assert vc.lookup(vs[1].content_digest()) is None   # evicted LRU
+    assert vc.lookup(vs[0].content_digest()) is not None
+    assert vc.lookup(vs[2].content_digest()) is not None
+
+
+def test_tenant_quota_eviction_never_crosses_tenants():
+    one = _payload_nbytes(b"q0")
+    vc = make_cache(budget_bytes=8 * one, tenant_quota_bytes=one)
+    a0, a1 = verifier_for(b"qa0"), verifier_for(b"qa1")
+    b0 = verifier_for(b"qb0")
+    vc.store(b0, True, tenant="chain-b")
+    vc.store(a0, True, tenant="chain-a")
+    vc.store(a1, True, tenant="chain-a")  # evicts a0 (own partition)
+    assert vc.counters["evictions"] == 1
+    assert vc.lookup(a0.content_digest(), tenant="chain-a") is None
+    assert vc.lookup(b0.content_digest(),
+                     tenant="chain-b") is not None, \
+        "chain-a churn must never evict chain-b"
+
+
+def test_over_budget_store_is_refused_and_counted():
+    """Review-hardened observability: an over-budget refusal with NO
+    quota armed (the default config) must still be visible."""
+    vc = make_cache(budget_bytes=16, tenant_quota_bytes=0)
+    assert vc.store(verifier_for(b"big"), True) is False
+    assert vc.counters["budget_rejected"] == 1
+    assert vc.counters["quota_rejected"] == 0
+
+
+def test_resident_bytes_accounting_stays_exact():
+    """The running byte counter (_publish's O(1) read) must track the
+    entry map exactly through store/replace/evict/drop."""
+    one = _payload_nbytes(b"rb0")
+    vc = make_cache(budget_bytes=2 * one)
+    vs = [verifier_for(b"rb%d" % i) for i in range(3)]
+    for v in vs:
+        vc.store(v, True)  # third store evicts the LRU
+    assert vc.resident_bytes() == sum(
+        e.nbytes for e in vc._entries.values()) == 2 * one
+    vc.store(vs[2].clone(), True)  # idempotent replace
+    assert vc.resident_bytes() == 2 * one
+    vc.bump_epoch("x")
+    vc.lookup(vs[1].content_digest())  # stale drop
+    assert vc.resident_bytes() == sum(
+        e.nbytes for e in vc._entries.values())
+    vc.drop_all("x")
+    assert vc.resident_bytes() == 0
+
+
+def test_quota_refusal_paths_are_counted_and_verdict_neutral():
+    one = _payload_nbytes(b"r0")
+    # entry bigger than the quota: refused outright
+    vc = make_cache(budget_bytes=8 * one, tenant_quota_bytes=one // 2)
+    assert vc.store(verifier_for(b"r0"), True, tenant="t") is False
+    assert vc.counters["quota_rejected"] == 1
+    # other tenants' bytes crowd the global budget: feasibility refusal
+    vc2 = make_cache(budget_bytes=2 * one, tenant_quota_bytes=2 * one)
+    vc2.store(verifier_for(b"r1"), True, tenant="big")
+    vc2.store(verifier_for(b"r2"), True, tenant="big")
+    assert vc2.store(verifier_for(b"r3"), True, tenant="small") is False
+    assert vc2.counters["quota_rejected"] == 1
+    assert vc2.lookup(verifier_for(b"r1").content_digest(),
+                      tenant="big") is not None
+
+
+# -- service integration ---------------------------------------------------
+
+
+def test_hit_resolves_without_queue_occupancy():
+    svc, fc = make_service()
+    t1 = svc.submit(entries_for(b"s1"), cls=tenancy.CLASS_MEMPOOL)
+    svc.process_once()
+    assert t1.result(5) is True
+    t2 = svc.submit(entries_for(b"s1"), cls=tenancy.CLASS_CONSENSUS)
+    assert t2.done() and t2.result(0) is True
+    st = svc.stats()
+    assert st["queue_sigs"] == 0 and st["queue_requests"] == 0
+    assert st["verdict_cache_hits"] == 1
+    assert st["verdict_cache_stores"] == 1
+    assert st["by_class"]["consensus"]["resolved"] == 1
+    assert st["waves"] == 1, "the hit must not have cost a wave"
+    svc.close()
+
+
+def test_any_class_writes_consensus_serves_per_class_policy():
+    """A mempool admission's verified outcome pre-pays the consensus
+    verify (write from any class); the consensus hit records the
+    writer class and rides the unconditional re-hash."""
+    svc, fc = make_service()
+    svc.submit(entries_for(b"pc"), cls=tenancy.CLASS_MEMPOOL)
+    svc.process_once()
+    vc = svc.verdict_cache
+    d = verifier_for(b"pc").content_digest()
+    hit = vc.lookup(d)
+    assert hit is not None
+    assert hit.writer_cls == tenancy.CLASS_MEMPOOL
+    t = svc.submit(entries_for(b"pc"), cls=tenancy.CLASS_CONSENSUS)
+    assert t.done() and t.result(0) is True
+    svc.close()
+
+
+def test_hits_bypass_watermark_shedding():
+    """Shed/watermark accounting excludes hits: a class that is
+    actively SHEDDING still serves memo hits — no queue pressure, no
+    admission decision, no Overloaded."""
+    svc, fc = make_service(capacity_sigs=10, rpc_watermark=0.2,
+                           low_watermark=0.1)
+    warm = svc.submit(entries_for(b"wmk"), cls=tenancy.CLASS_RPC)
+    svc.process_once()
+    assert warm.result(5) is True
+    # arm rpc shedding with mempool-class depth over the rpc watermark
+    svc.submit(entries_for(b"fill", n=4), cls=tenancy.CLASS_MEMPOOL)
+    with pytest.raises(service.Overloaded):
+        svc.submit(entries_for(b"fresh-rpc"), cls=tenancy.CLASS_RPC)
+    t = svc.submit(entries_for(b"wmk"), cls=tenancy.CLASS_RPC)
+    assert t.done() and t.result(0) is True, \
+        "a memo hit must resolve even while its class sheds"
+    svc.process_once()
+    svc.close()
+
+
+def test_content_digest_none_batches_always_bypass_the_cache():
+    """The pinned bypass: exposed-map and post-invalidate batches
+    (content_digest() is None) neither look up nor store — submitted
+    twice, they verify twice."""
+    svc, fc = make_service()
+    for _ in range(2):
+        v = batch.Verifier()
+        v.queue_bulk(entries_for(b"byp"))
+        _ = v.signatures  # exposure voids the digest
+        assert v.content_digest() is None
+        t = svc.submit(v)
+        assert not t.done()
+        svc.process_once()
+        assert t.result(5) is True
+    st = svc.stats()
+    assert st["verdict_cache_hits"] == 0
+    assert st["verdict_cache_stores"] == 0
+    assert st["waves"] == 2
+    # ...and the invalidate() path memoizes nothing either
+    vi = batch.Verifier()
+    vi.queue_bulk(entries_for(b"byp2"))
+    vi.invalidate("suspect wire bytes")
+    t = svc.submit(vi)
+    svc.process_once()
+    assert t.result(5) is False
+    assert svc.stats()["verdict_cache_stores"] == 0
+    svc.close()
+
+
+def test_disabled_cache_means_full_verification_every_time():
+    svc, fc = make_service(verdict_cache=make_cache(enabled=False))
+    for _ in range(2):
+        t = svc.submit(entries_for(b"off"))
+        assert not t.done()
+        svc.process_once()
+        assert t.result(5) is True
+    st = svc.stats()
+    assert st["verdict_cache_hits"] == 0 and st["waves"] == 2
+    svc.close()
+
+
+def test_dedup_and_memo_compose_across_waves():
+    """Wave 1: three identical submissions dedup intra-wave (decided
+    once); wave 2: the same content hits the memo without queueing."""
+    svc, fc = make_service()
+    tickets = [svc.submit(entries_for(b"both")) for _ in range(3)]
+    svc.process_once()
+    assert [t.result(5) for t in tickets] == [True] * 3
+    assert svc.totals["dedup_fanout"] == 2
+    t4 = svc.submit(entries_for(b"both"))
+    assert t4.done() and t4.result(0) is True
+    assert svc.totals["verdict_cache_hits"] == 1
+    assert svc.totals["waves"] == 1
+    svc.close()
+
+
+# -- the ZIP215 small-order × non-canonical matrix -------------------------
+
+MSG = b"Zcash"
+
+
+def _matrix_cases():
+    from ed25519_consensus_tpu.ops import edwards
+    from ed25519_consensus_tpu.utils import fixtures
+
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    s_bytes = b"\x00" * 32
+    return [(A, R + s_bytes) for A in encs for R in encs]
+
+
+def _matrix_verifiers():
+    """One single-signature Verifier per matrix case (196), plus a few
+    honest/tampered ordinary signatures so both verdicts ride every
+    path.  ZIP215 truth for every torsion case is True (s = 0 and
+    small-order A, R make both sides vanish)."""
+    vs = []
+    for i, (A, sig) in enumerate(_matrix_cases()):
+        v = batch.Verifier()
+        v.queue((VerificationKeyBytes(A), Signature.from_bytes(sig),
+                 MSG))
+        vs.append((f"case-{i}", v, True))
+    for i in range(4):
+        sk = KEYS[i % len(KEYS)]
+        m = b"matrix-mix-%d" % i
+        good = i % 2 == 0
+        sig = sk.sign(m if good else b"evil")
+        v = batch.Verifier()
+        v.queue((sk.verification_key_bytes(), sig, m))
+        vs.append((f"mix-{i}", v, good))
+    return vs
+
+
+def _replay_matrix_through(svc, label):
+    """Submit a fresh clone of every matrix verifier; returns the
+    verdicts keyed by case id."""
+    out = {}
+    tickets = []
+    for ident, v, want in _matrix_verifiers():
+        t = svc.submit(v.clone())
+        tickets.append((ident, t, want))
+    while svc.process_once():
+        pass
+    for ident, t, want in tickets:
+        got = t.result(10)
+        assert got == want, f"{label}: {ident} verdict diverged"
+        out[ident] = got
+    return out
+
+
+@pytest.mark.parametrize("path", ["miss", "hit", "stale", "corrupt",
+                                  "evict", "quota-refused"])
+def test_zip215_matrix_bit_identical_through_every_cache_path(path):
+    """The full 196-case small-order × non-canonical matrix (plus
+    honest/tampered mixins) replayed through each verdict-cache path:
+    every verdict bit-identical to the analytic ZIP215 oracle."""
+    if path == "quota-refused":
+        vc = make_cache(budget_bytes=1 << 20, tenant_quota_bytes=8)
+    else:
+        vc = make_cache()
+    svc, fc = make_service(capacity_sigs=1 << 16, verdict_cache=vc)
+    _replay_matrix_through(svc, f"{path}/prime")  # misses + stores
+    plan = None
+    if path == "stale":
+        vc.bump_epoch("matrix")
+    elif path == "corrupt":
+        plan = faults.verdictcache_plan(0x215, "corrupt-verdict",
+                                        at=0, length=1 << 12)
+        faults.install(plan)
+    elif path == "evict":
+        plan = faults.verdictcache_plan(0x216, "evict",
+                                        at=0, length=1 << 12)
+        faults.install(plan)
+    try:
+        _replay_matrix_through(svc, f"{path}/replay")
+    finally:
+        if plan is not None:
+            faults.uninstall()
+    if path == "hit":
+        assert svc.totals["verdict_cache_hits"] == 200
+    elif path == "quota-refused":
+        assert vc.counters["quota_rejected"] > 0
+        assert svc.totals["verdict_cache_hits"] == 0
+    elif path == "corrupt":
+        assert vc.counters["rehash_mismatch"] == 200
+        assert svc.totals["verdict_cache_hits"] == 0
+    elif path == "stale":
+        assert vc.counters["stale_epoch"] == 200
+    elif path == "evict":
+        assert svc.totals["verdict_cache_hits"] == 0
+    svc.close()
+
+
+@pytest.mark.slow
+def test_zip215_matrix_hit_miss_on_virtual_mesh(monkeypatch):
+    """The matrix's miss→hit replay with device-participating waves on
+    the virtual mesh (single-device and the 2-chip rung): the memo
+    layer sits above routing, so verdicts stay bit-identical to the
+    analytic oracle whichever lane decided the miss."""
+    from ed25519_consensus_tpu import routing
+
+    monkeypatch.delenv("ED25519_TPU_DISABLE_DEVICE", raising=False)
+    pytest.importorskip("jax")
+    meshes = [0]
+    if routing.available_devices() >= 2:
+        meshes.append(2)
+    for mesh in meshes:
+        svc, fc = make_service(capacity_sigs=1 << 16, mesh=mesh,
+                               chunk=8, hybrid=True)
+        _replay_matrix_through(svc, f"mesh{mesh}/miss")
+        _replay_matrix_through(svc, f"mesh{mesh}/hit")
+        assert svc.totals["verdict_cache_hits"] == 200
+        svc.close()
+
+
+# -- federation: namespaced stores + front-door dedup ----------------------
+
+_FKEYS = {t: [SigningKey.new(random.Random(0xFE0 + i + hash(t) % 97))
+              for i in range(3)]
+          for t in ("chain-a", "chain-b")}
+
+
+def fed_verifier(tenant, i, bad=False):
+    v = batch.Verifier()
+    for j, sk in enumerate(_FKEYS[tenant]):
+        m = b"vcfed %s %d %d" % (tenant.encode(), i, j)
+        sig = sk.sign(m)
+        if bad and j == 1:
+            m += b"!"
+        v.queue((sk.verification_key_bytes(), sig, m))
+    return v
+
+
+def host_factory(capacity=4096):
+    def factory(rid, clock, cache):
+        return service.VerifyService(
+            capacity_sigs=capacity, clock=clock, auto_start=False,
+            replica_id=f"r{rid}", cache=cache, mesh=0,
+            health=service._HostOnlyHealth(clock),
+            rng=random.Random(rid))
+
+    return factory
+
+
+def make_set(replicas=3, capacity=4096, **kw):
+    clock = health.FakeClock()
+    fs = federation.ReplicaSet(
+        replicas, service_factory=host_factory(capacity), clock=clock,
+        capacity_sigs=capacity, **kw)
+    return fs, clock
+
+
+def drain(fs, rounds=50):
+    for _ in range(rounds):
+        if fs.process_once() == 0:
+            break
+
+
+def test_replicas_get_namespaced_verdict_caches():
+    fs, clock = make_set(3)
+    try:
+        assert sorted(r.vcache.namespace
+                      for r in fs.replicas.values()) == ["r0", "r1",
+                                                         "r2"]
+        for r in fs.replicas.values():
+            assert r.service.verdict_cache is r.vcache
+            assert r.vcache._companion is r.cache
+    finally:
+        fs.close()
+
+
+@pytest.mark.parametrize("bad", [False, True])
+def test_front_door_dedup_shares_one_ticket(bad):
+    """Identical concurrent submissions for the same home share ONE
+    federated ticket — regression-pinned for True AND False verdicts."""
+    fs, clock = make_set(3)
+    try:
+        t1 = fs.submit(fed_verifier("chain-a", 1, bad=bad),
+                       tenant="chain-a")
+        t2 = fs.submit(fed_verifier("chain-a", 1, bad=bad),
+                       tenant="chain-a")
+        t3 = fs.submit(fed_verifier("chain-a", 1, bad=bad),
+                       tenant="chain-a")
+        assert t2 is t1 and t3 is t1, "one in-flight ticket is shared"
+        assert fs.totals["dedup_fanout"] == 2
+        # deduped submissions ride the original's placement: the
+        # affinity surface counts them the same way (a deflated
+        # hit-rate exactly when dedup works best was a review catch)
+        assert fs.affinity_hit_rate() == 1.0
+        drain(fs)
+        want = not bad
+        assert t1.result(5) is want
+        st = fs.stats()
+        assert st["dedup_fanout"] == 2
+        assert sum(row["dedup_fanout"]
+                   for row in st["replicas"].values()) == 2
+        # resolved entries leave the ledger; the next identical
+        # submission is the VERDICT CACHE's business, not dedup's
+        t4 = fs.submit(fed_verifier("chain-a", 1, bad=bad),
+                       tenant="chain-a")
+        assert t4 is not t1
+        assert t4.done() and t4.result(0) is want
+        assert fs.totals["dedup_fanout"] == 2
+    finally:
+        fs.close()
+
+
+def test_front_door_dedup_skips_incompatible_deadlines_and_classes():
+    fs, clock = make_set(3)
+    try:
+        v0 = fed_verifier("chain-b", 7)
+        t1 = fs.submit(v0.clone(), tenant="chain-b",
+                       cls=tenancy.CLASS_MEMPOOL)
+        # different class: no sharing
+        t2 = fs.submit(v0.clone(), tenant="chain-b",
+                       cls=tenancy.CLASS_CONSENSUS)
+        assert t2 is not t1
+        # in-flight has NO deadline; a deadline-carrying submission
+        # must not borrow it
+        t3 = fs.submit(v0.clone(), tenant="chain-b",
+                       cls=tenancy.CLASS_MEMPOOL, timeout=5.0)
+        assert t3 is not t1
+        assert fs.totals["dedup_fanout"] == 0
+        drain(fs)
+    finally:
+        fs.close()
+
+
+def test_failover_reissue_can_warm_and_hit_the_peers_store():
+    """Affinity-order semantics: after the home replica is ejected,
+    the SAME content re-submitted lands on the next replica in
+    affinity order, re-verifies there (re-issue is re-verification),
+    and subsequent replays hit the PEER's own memo store."""
+    fs, clock = make_set(3)
+    try:
+        v = fed_verifier("chain-a", 3)
+        t1 = fs.submit(v.clone(), tenant="chain-a")
+        home = t1.replica_id
+        drain(fs)
+        assert t1.result(5) is True
+        # the home's memo store took the write
+        assert fs.replicas[home].vcache.resident_count() == 1
+        # eject the home: its store dies with it
+        fs._eject(fs.replicas[home], "test ejection", crashed=True)
+        assert fs.replicas[home].vcache.resident_count() == 0
+        t2 = fs.submit(v.clone(), tenant="chain-a")
+        peer = t2.replica_id
+        assert peer != home
+        drain(fs)
+        assert t2.result(5) is True, "peer re-verifies, never transfers"
+        t3 = fs.submit(v.clone(), tenant="chain-a")
+        assert t3.replica_id == peer
+        assert t3.done() and t3.result(0) is True
+        assert fs.replicas[peer].service.stats()[
+            "verdict_cache_hits"] == 1
+    finally:
+        fs.close()
+
+
+# -- quota auto-sizing over both caches ------------------------------------
+
+
+def test_suggest_tenant_quotas_folds_in_verdict_demand():
+    dev_stats = {
+        "a": {"hits": 80, "misses": 20, "hit_rate": 0.8},
+        "b": {"hits": 0, "misses": 0, "hit_rate": None},
+    }
+    verdict_stats = {
+        "b": {"hits": 50, "misses": 50, "hit_rate": 0.5},
+    }
+    solo = devcache.suggest_tenant_quotas(dev_stats, 1000)
+    assert set(solo) == {"a"} and solo["a"] == 1000
+    both = devcache.suggest_tenant_quotas(dev_stats, 1000,
+                                          verdict_stats=verdict_stats)
+    assert set(both) == {"a", "b"}
+    # a: 100·1.2 = 120; b: 100·1.5 = 150 → b outweighs a
+    assert both["b"] > both["a"] > 0
+    assert both["a"] + both["b"] <= 1000
+
+
+def test_quota_suggestions_report_only_and_knob_gated(monkeypatch):
+    devc = devcache.DeviceOperandCache(budget_bytes=1 << 16,
+                                       enabled=True)
+    vc = make_cache()
+    vc.store(verifier_for(b"qs"), True, tenant="t1")
+    vc.lookup(verifier_for(b"qs").content_digest(), tenant="t1")
+    monkeypatch.delenv("ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE",
+                       raising=False)
+    assert devc.quota_suggestions(vc.tenant_stats()) == {}
+    monkeypatch.setenv("ED25519_TPU_DEVCACHE_QUOTA_AUTOSIZE", "1")
+    sugg = devc.quota_suggestions(vc.tenant_stats())
+    assert sugg and "t1" in sugg
+    assert devc.tenant_quota_bytes == 0, "report-only: nothing armed"
+
+
+# -- residency-drop conservatism -------------------------------------------
+
+
+def test_lane_death_forfeits_default_memo_store():
+    """The health residency-drop listener: a lane marked stuck bumps
+    the DEFAULT verdict cache's epoch — memoized verdicts decided
+    while a now-distrusted device participated are re-decided."""
+    verdictcache.set_default_cache(None)
+    vc = verdictcache.default_cache()
+    v = verifier_for(b"lane")
+    vc.store(v, True)
+    assert vc.lookup(v.content_digest()) is not None
+    before = vc.epoch
+    health.notify_residency_drop("test lane death")
+    assert vc.epoch == before + 1
+    assert vc.lookup(v.content_digest()) is None
+    verdictcache.set_default_cache(None)

@@ -64,7 +64,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from ed25519_consensus_tpu import (  # noqa: E402
     SigningKey, batch, config, devcache, faults, federation, health,
-    routing, service, tenancy,
+    routing, service, tenancy, verdictcache,
 )
 from ed25519_consensus_tpu.utils import metrics  # noqa: E402
 
@@ -226,6 +226,16 @@ def run_lab(cfg) -> dict:
             budget_bytes=int(2.5 * entry_bytes), enabled=True,
             tenant_quota_bytes=int(1.2 * entry_bytes))
     devcache.set_default_cache(cache)
+    # A FRESH verdict cache per run, companioned to this run's
+    # devcache: the lab's batches are unique within a run (no memo
+    # effect on its dynamics), but a load sweep replays the SAME
+    # seeded scenario several times in one process — ambient memo
+    # state from a previous point would fast-path later points and
+    # break the replay-digest purity.  Per-run isolation keeps every
+    # point the same pure function of the seed.
+    vcache = verdictcache.VerdictCache(companion=cache,
+                                       namespace="trafficlab")
+    verdictcache.set_default_cache(vcache)
 
     svc = service.VerifyService(
         capacity_sigs=capacity_sigs,
@@ -325,6 +335,7 @@ def run_lab(cfg) -> dict:
         if plan is not None:
             faults.uninstall()
         devcache.set_default_cache(None)
+        verdictcache.set_default_cache(None)
 
     return summarize(cfg, matrix, requests, svc, cache, rate,
                      capacity_sigs, t_cap, horizon, t0)
