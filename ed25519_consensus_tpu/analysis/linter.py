@@ -94,9 +94,10 @@ _FLOAT_DTYPES = frozenset(
 # invocation passes it explicitly.)
 _CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
                   "faults.py", "devcache.py", "tenancy.py",
-                  "federation.py", "verdictcache.py",
+                  "federation.py", "verdictcache.py", "persist.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
-                  "tools/sentinel_soak.py", "tools/replay_lab.py")
+                  "tools/sentinel_soak.py", "tools/replay_lab.py",
+                  "tools/restart_lab.py")
 _CL004_ALLOWED = {
     "batch.py": frozenset((
         "_shift128_cache", "_key_row_cache", "_host_split_cache",
@@ -130,9 +131,10 @@ _LOCK_CONSTRUCTORS = frozenset(
      "BoundedSemaphore", "Barrier"))
 
 _CL006_MODULES = ("batch.py", "service.py", "tenancy.py",
-                  "federation.py", "verdictcache.py",
+                  "federation.py", "verdictcache.py", "persist.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
-                  "tools/sentinel_soak.py", "tools/replay_lab.py")
+                  "tools/sentinel_soak.py", "tools/replay_lab.py",
+                  "tools/restart_lab.py")
 _CL005_SECRET_ATTRS = frozenset(("s", "prefix"))
 _CL005_SECRET_CALLS = frozenset(("to_bytes", "__bytes__"))
 
@@ -537,7 +539,8 @@ def _check_cl006(mod: ParsedModule):
 # call graph); the semantic half — a flipped stored verdict is never
 # published — is pinned by the CorruptStoredVerdict fault tests.
 _CL007_MODULES = ("batch.py", "service.py", "verdictcache.py",
-                  "federation.py", "tools/replay_lab.py")
+                  "federation.py", "persist.py",
+                  "tools/replay_lab.py", "tools/restart_lab.py")
 _CL007_VERDICT_SYMBOLS = (
     "verify_many", "_host_verdict", "_resolve_union",
     "verify_single_many", "Verifier.verify", "VerifyService._execute",

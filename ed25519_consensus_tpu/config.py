@@ -284,6 +284,28 @@ KNOBS: "dict[str, Knob]" = dict([
        "Default seed for tools/replay_lab.py's mempool→block→vote-"
        "replay scenario, fresh-traffic interleaving, and fault "
        "windows (the run is a pure function of it)."),
+    _k("ED25519_TPU_PERSIST_DIR", "path", None,
+       "Directory for the verdict-store journal/snapshot files "
+       "(persist.py — crash-consistent restart warmth); unset/empty "
+       "disables persistence and the memo store is process-lifetime "
+       "only."),
+    _k("ED25519_TPU_PERSIST_FSYNC", "choice", "close",
+       "Verdict-journal fsync policy: `always` (fsync every appended "
+       "record), `close` (fsync on service drain/flush and snapshot "
+       "compaction), or `never` (page cache only); the policy trades "
+       "post-crash WARMTH, never correctness — an unsynced record is "
+       "simply one the loader never sees.",
+       ("always", "close", "never")),
+    _k("ED25519_TPU_PERSIST_MAX_BYTES", "int", 1 << 26,
+       "Verdict-journal size in bytes above which the next append "
+       "triggers an atomic snapshot compaction (live entries "
+       "re-exported to a temp file, then rename) — bounds disk growth "
+       "from append-only churn."),
+    _k("ED25519_TPU_RESTART_LAB_SEED", "int", 0x5EED17,
+       "Default seed for tools/restart_lab.py's kill-and-revive "
+       "scenario: the replayed workload, the mid-traffic crash point, "
+       "and the persistence-storm fault windows (the run is a pure "
+       "function of it)."),
 ])
 
 

@@ -600,6 +600,45 @@ class DeviceOperandCache:
             self._seen.add(digest)
             return False
 
+    def export_warm_hints(self) -> "list[bytes]":
+        """Warm-digest hints for a rejoining peer (federation rejoin
+        pre-warm, ROADMAP item 4): the digests this cache currently
+        holds RESIDENT, sorted for determinism.  Hints carry no
+        operand bytes and no trust — an importer only seeds its
+        second-sight ledger; the hinted keysets still stage from its
+        OWN host bytes and still re-hash per hit."""
+        with self._lock:
+            return sorted({d for (d, _kind) in self._entries})
+
+    def import_warm_hints(self, hints) -> "tuple[int, int]":
+        """Seed the second-sight ledger (`_seen`) from a peer's
+        warm-digest hints: a hinted keyset then builds residency on
+        its FIRST local sighting instead of its second — the build
+        policy itself is unchanged (`should_build` still answers,
+        builds still stage from local host bytes, entries still
+        re-hash per hit), so a hint can cost at most one build's worth
+        of wasted staging, never a verdict or a stale byte.  Returns
+        (accepted, refused): malformed hints (anything but a 32-byte
+        digest) and hints past the ledger bound are refused — a hint
+        is an optimization, never correctness state."""
+        accepted = refused = 0
+        if not self.enabled:
+            return 0, sum(1 for _ in hints)
+        with self._lock:
+            for h in hints:
+                if not isinstance(h, (bytes, bytearray)) \
+                        or len(h) != 32:
+                    refused += 1
+                    continue
+                h = bytes(h)
+                if h not in self._seen:
+                    if len(self._seen) >= self._seen_max:
+                        refused += 1
+                        continue
+                    self._seen.add(h)
+                accepted += 1
+        return accepted, refused
+
     def build(self, digest: bytes, n_keys: int,
               head_tensor,
               kind: str = KIND_HEAD) -> "ResidentKeyset | None":

@@ -54,6 +54,7 @@ import numpy as np
 
 __all__ = [
     "SITE_LANE", "SITE_SHARDED", "SITE_DEVCACHE", "SITE_REPLICA",
+    "SITE_VERDICTCACHE", "SITE_PERSIST",
     "InjectedFault",
     "TransientDispatchError", "FatalChipError",
     "ReplicaCrashError", "ReplicaWedgeError",
@@ -64,9 +65,11 @@ __all__ = [
     "RotateTenant", "ChipLoss", "LinkFlap",
     "ReplicaCrash", "ReplicaWedge", "SplitCapacity",
     "CorruptStoredVerdict",
+    "TornWrite", "BitRot", "TruncateJournal", "VersionSkew",
+    "StaleEpochPins",
     "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
     "mesh_plan", "sentinel_plan", "typed_error_plan", "replica_plan",
-    "verdictcache_plan",
+    "verdictcache_plan", "persist_plan",
     "install", "uninstall", "injected", "active_plan",
     "run_device_call",
 ]
@@ -89,6 +92,12 @@ SITE_REPLICA = "replica"
 # deterministically between a submission and the memo it would have
 # been served from.
 SITE_VERDICTCACHE = "verdictcache"
+# The verdict journal's append boundary (persist.py): "call index"
+# counts journal record appends, and ctx.payload is the VerdictJournal
+# itself (path + last_record_span), so the persistence storms corrupt
+# the on-disk bytes deterministically between two well-formed appends
+# — exactly the state a crash leaves behind for recovery to judge.
+SITE_PERSIST = "persist"
 
 
 class InjectedFault(RuntimeError):
@@ -699,6 +708,133 @@ class RotateTenant(Fault):
                                       "rotation fault (mid-wave)")
 
 
+# -- persistence storms (SITE_PERSIST; ctx.payload is the journal) --------
+#
+# All five act AFTER a completed journal append — the file is corrupted
+# between two well-formed writes, exactly the state a crash/rot event
+# leaves behind for the NEXT process's recovery to judge.  None of them
+# can change a verdict by construction: a journal record only ever
+# re-enters a cache through the absorb/re-hash gate, so every storm
+# degrades to dropped records (or a dropped file) and full
+# verification — warmth, never answers (tools/restart_lab.py gates
+# verdict bit-identity under each).
+
+
+class TornWrite(Fault):
+    """Tear the LAST appended record: truncate the file so only `frac`
+    of that record's bytes survive — the shape of a crash (or full
+    disk) landing mid-append.  Recovery's framing walk finds the torn
+    tail and drops it; every record before the tear still loads."""
+
+    def __init__(self, on=0, frac: float = 0.5):
+        super().__init__(on=on, site=SITE_PERSIST)
+        self.frac = float(frac)
+
+    def after(self, ctx, out):
+        span = getattr(ctx.payload, "last_record_span", None)
+        if span is not None:
+            offset, length = span
+            keep = offset + max(1, int(length * self.frac))
+            with open(ctx.payload.path, "rb+") as fh:
+                fh.truncate(keep)
+        return out
+
+
+class BitRot(Fault):
+    """Flip bit(s) inside the LAST appended record's bytes
+    (deterministically from the plan seed) — storage rot under an
+    intact file structure.  The per-record hash (and, depending on
+    where the flip lands, the payload re-hash or seal gate) catches it
+    at load; a flip that lands after a fsync-less crash is caught by
+    the same gates on the next process's load."""
+
+    def __init__(self, on=0, flips: int = 1):
+        super().__init__(on=on, site=SITE_PERSIST)
+        self.flips = int(flips)
+
+    def after(self, ctx, out):
+        span = getattr(ctx.payload, "last_record_span", None)
+        if span is not None:
+            offset, length = span
+            rng = random.Random(_stable_seed(
+                ctx.plan.seed, ctx.site, ctx.index, "bitrot"))
+            with open(ctx.payload.path, "rb+") as fh:
+                for _ in range(max(1, self.flips)):
+                    pos = offset + rng.randrange(length)
+                    fh.seek(pos)
+                    b = fh.read(1)
+                    fh.seek(pos)
+                    fh.write(bytes((b[0] ^ (1 << rng.randrange(8)),)))
+        return out
+
+
+class TruncateJournal(Fault):
+    """Truncate the journal's RECORD REGION to `frac` of its bytes
+    (the header survives) — a lost tail bigger than one append: an
+    fsync-less crash dropping page-cache pages, a copy that never
+    finished.  Recovery loads every record before the cut and drops
+    the torn remainder."""
+
+    def __init__(self, on=0, frac: float = 0.5):
+        super().__init__(on=on, site=SITE_PERSIST)
+        self.frac = float(frac)
+
+    def after(self, ctx, out):
+        from . import persist as _persist
+
+        path = ctx.payload.path
+        with open(path, "rb") as fh:
+            data = fh.read()
+        parsed, _reason = _persist._parse_header(data)
+        if parsed is not None:
+            start = parsed["end"]
+            keep = start + int((len(data) - start) * self.frac)
+            with open(path, "rb+") as fh:
+                fh.truncate(keep)
+        return out
+
+
+class VersionSkew(Fault):
+    """Rewrite the journal header to a FUTURE format version — the
+    downgrade-after-upgrade shape (a newer build wrote the file, an
+    older one recovers it).  The header hash is recomputed VALID
+    (persist.rewrite_header), so the gate under test is the version
+    gate itself: recovery must drop the WHOLE file rather than guess
+    at a format it does not speak."""
+
+    def __init__(self, on=0, skew: int = 1):
+        super().__init__(on=on, site=SITE_PERSIST)
+        self.skew = int(skew)
+
+    def after(self, ctx, out):
+        from . import persist as _persist
+
+        _persist.rewrite_header(
+            ctx.payload.path,
+            version=_persist.FORMAT_VERSION + max(1, self.skew))
+        return out
+
+
+class StaleEpochPins(Fault):
+    """Bump the header's GLOBAL epoch pin far above every record's —
+    the file now claims a forfeiture happened after all of them (an
+    epoch bump whose records never made it to disk).  The header hash
+    is recomputed VALID, so the gate under test is the stale-pin rule:
+    recovery must drop every record as pre-forfeiture and start
+    cold."""
+
+    def __init__(self, on=0, bump: int = 1000):
+        super().__init__(on=on, site=SITE_PERSIST)
+        self.bump = int(bump)
+
+    def after(self, ctx, out):
+        from . import persist as _persist
+
+        _persist.rewrite_header(ctx.payload.path,
+                                epoch_bump=max(1, self.bump))
+        return out
+
+
 class _CallContext:
     __slots__ = ("plan", "site", "index", "mesh", "clock", "payload")
 
@@ -904,6 +1040,47 @@ def verdictcache_plan(seed: int, kind: str, at: int = 0,
         faults = [StaleEpochOn(on=window, site=SITE_VERDICTCACHE)]
     else:
         raise ValueError(f"unknown verdictcache fault kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
+def persist_plan(seed: int, kind: str, at: int = 0, length: int = 1,
+                 frac: float = 0.5, flips: int = 1,
+                 skew: int = 1, bump: int = 1000) -> FaultPlan:
+    """A persistence-storm window over the VERDICT-JOURNAL append
+    stream (SITE_PERSIST; indices count journal record appends —
+    tools/restart_lab.py replays a kill-and-revive cycle under each):
+
+    * ``"torn"``         — tear the appended record at `frac` of its
+      bytes (crash mid-write; recovery drops the torn tail, keeps
+      everything before it);
+    * ``"bitrot"``       — flip `flips` bit(s) in the appended
+      record's on-disk bytes (caught by the per-record hash /
+      payload-re-hash / seal gates at load);
+    * ``"truncate"``     — truncate the record region to `frac` of its
+      bytes (a lost multi-record tail);
+    * ``"version-skew"`` — rewrite the header to FORMAT_VERSION+`skew`
+      with a valid hash (recovery drops the whole file);
+    * ``"stale-pins"``   — bump the header's global epoch pin by
+      `bump` with a valid hash (recovery drops every record as
+      pre-forfeiture).
+
+    Every storm degrades to dropped records/files and full
+    verification — warmth, never answers.  Same replay property as
+    every other plan: decisions are pure functions of (seed, site,
+    call index)."""
+    window = range(at, at + max(1, length))
+    if kind == "torn":
+        faults = [TornWrite(on=window, frac=frac)]
+    elif kind == "bitrot":
+        faults = [BitRot(on=window, flips=flips)]
+    elif kind == "truncate":
+        faults = [TruncateJournal(on=window, frac=frac)]
+    elif kind == "version-skew":
+        faults = [VersionSkew(on=window, skew=skew)]
+    elif kind == "stale-pins":
+        faults = [StaleEpochPins(on=window, bump=bump)]
+    else:
+        raise ValueError(f"unknown persist fault kind {kind!r}")
     return FaultPlan(faults, seed=seed)
 
 

@@ -75,6 +75,7 @@ from . import config as _config
 from . import devcache as _devcache
 from . import faults as _faults
 from . import health as _health
+from . import persist as _persist
 from . import routing as _routing
 from . import service as _service
 from . import tenancy as _tenancy
@@ -205,7 +206,8 @@ class ReplicaSet:
                  registry: "_health.ReplicaRegistry | None" = None,
                  capacity_sigs: int = 65536,
                  devcache_budget_bytes: "int | None" = None,
-                 probe_seed: int = 0):
+                 probe_seed: int = 0,
+                 persist_dir: "str | None" = None):
         if replicas < 1:
             raise ValueError("a federation needs at least one replica")
         self._clock = clock if clock is not None else _health.SYSTEM_CLOCK
@@ -238,6 +240,11 @@ class ReplicaSet:
             # federated ticket — one placement, one wave slot, one
             # ladder decision fanned out to every submitter.
             "dedup_fanout": 0,
+            # Rejoin pre-warm (this round): warm-digest hints imported
+            # from live peers when a replica passes probation, and the
+            # hints the second-sight ledger refused (disabled cache,
+            # malformed digest, ledger full).
+            "prewarm_hits": 0, "prewarm_refused": 0,
         }
         self.error_classes = {_health.ERROR_TRANSIENT: 0,
                               _health.ERROR_FATAL: 0,
@@ -262,6 +269,13 @@ class ReplicaSet:
                 namespace=f"r{rid}", companion=cache)
             svc = self._factory(rid, self._clock, cache)
             svc.verdict_cache = vcache
+            # Per-replica durable verdict state: each replica journals
+            # into its OWN namespaced file (verdicts-r<rid>.vjournal),
+            # so reviving r2 replays r2's store — never a peer's.
+            # attach() runs recovery (trust-ladder load + compaction)
+            # before the replica takes its first submit.
+            if persist_dir is not None:
+                _persist.attach(vcache, directory=persist_dir)
             self.replicas[rid] = Replica(rid, svc, cache, vcache)
             self._tracked[rid] = {}
 
@@ -674,9 +688,15 @@ class ReplicaSet:
                 self._sweep_ejected(rep)
                 rep.service = self._factory(rid, self._clock, rep.cache)
                 # Same namespaced memo store object (already dropped at
-                # ejection): the revived replica re-warms from traffic,
-                # exactly like its residency.
+                # ejection): the revived replica re-warms from its own
+                # journal when one is attached (persist.reload — the
+                # trust ladder re-verifies every record before it may
+                # serve), and from traffic for the rest — exactly like
+                # its residency.
                 rep.service.verdict_cache = rep.vcache
+                if rep.vcache is not None \
+                        and rep.vcache.journal() is not None:
+                    _persist.reload(rep.vcache)
                 rep.crashed = False
                 rep.degraded_frac = None
                 self.totals["revivals"] += 1
@@ -696,11 +716,39 @@ class ReplicaSet:
                 if self.registry.record_probe_pass(rid):
                     self.totals["rejoins"] += 1
                     _metrics.record_fault("replica_rejoined")
+                    self._prewarm_from_peers(rep)
             else:
                 self.totals["probe_failures"] += 1
                 self.registry.record_probe_fail(
                     rid, reason="probe verdict mismatch"
                     if ok else "probe dispatch failed")
+
+    def _prewarm_from_peers(self, rep: Replica) -> None:
+        """Cross-replica devcache pre-warm at REJOIN (ROADMAP item 4's
+        remainder): import every live peer's warm-digest hints into
+        the rejoined replica's second-sight ledger, so the keysets the
+        fleet is currently hot on build residency on their FIRST
+        post-rejoin sighting instead of their second.  Hints carry no
+        operand bytes and no trust (devcache.import_warm_hints): the
+        rejoined replica still stages from its own host bytes and
+        still re-hashes per hit — a refused or stale hint costs
+        nothing, which is why importing from peers whose affinity
+        slice differs is safe."""
+        if rep.cache is None:
+            return
+        hints = []
+        for rid2 in sorted(self.replicas):
+            peer = self.replicas[rid2]
+            if peer is rep or peer.crashed or peer.cache is None:
+                continue
+            hints.extend(peer.cache.export_warm_hints())
+        if not hints:
+            return
+        accepted, refused = rep.cache.import_warm_hints(hints)
+        self.totals["prewarm_hits"] += accepted
+        self.totals["prewarm_refused"] += refused
+        if accepted:
+            _metrics.record_fault("replica_prewarm", accepted)
 
     def pump_forever(self, stop_event: "threading.Event") -> None:
         """Drive `process_once` until `stop_event` is set — the

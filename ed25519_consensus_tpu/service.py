@@ -344,7 +344,8 @@ class VerifyService:
                  device_time_prior: float = 2.0,
                  rng=None, auto_start: bool = True,
                  replica_id: "str | None" = None,
-                 cache=None, verdict_cache=None):
+                 cache=None, verdict_cache=None,
+                 persist_dir: "str | None" = None):
         # Per-class admission policy (tenancy.py): mempool keeps the
         # (high, low) watermark pair — the exact pre-tenancy admission
         # semantics and the class `submit()` defaults to — rpc sheds
@@ -384,6 +385,14 @@ class VerifyService:
         # live so tests and knob flips take effect).  A ReplicaSet
         # overwrites this with the replica's namespaced instance.
         self.verdict_cache = verdict_cache
+        # Verdict-store persistence (persist.py): explicit journal
+        # directory, else the ED25519_TPU_PERSIST_DIR knob (resolved
+        # by persist.attach; unset keeps the store process-lifetime
+        # only).  Attached LAZILY at the first memo-path submit — the
+        # cache may be injected after construction (ReplicaSet does),
+        # and recovery must load before the first lookup could hit.
+        self._persist_dir = persist_dir
+        self._persist_attached = False
 
         self._cv = threading.Condition()
         # One FIFO queue per traffic class, drained in CLASSES priority
@@ -547,6 +556,16 @@ class VerifyService:
                        else _tenancy.DEFAULT_TENANT)
         vc = self._verdict_cache()
         if vc is not None:
+            if not self._persist_attached:
+                # One-time persistence attach (persist.py): recovery
+                # LOADS the journal before the first lookup could hit,
+                # then registers write-through appends.  No directory
+                # configured → attach is a cheap no-op; the flag keeps
+                # the knob read off the steady-state submit path.
+                self._persist_attached = True
+                from . import persist as _persist
+
+                _persist.attach(vc, directory=self._persist_dir)
             memo_digest = (_content_digest if _content_digest is not None
                            else v.content_digest())
             if memo_digest is not None:
@@ -1007,6 +1026,17 @@ class VerifyService:
         else:
             while drain and self.process_once(block=False):
                 pass
+        if drain:
+            # Graceful drain flushes the verdict journal (persist.py):
+            # every verdict decided by the drain is already appended —
+            # this forces the records to the platter (fsync policy
+            # permitting) so a clean shutdown restarts WARM.  A hard
+            # kill skips this by definition; recovery then salvages
+            # whatever the crash left (tools/restart_lab.py's gate).
+            vc = self._verdict_cache()
+            journal = vc.journal() if vc is not None else None
+            if journal is not None:
+                journal.flush()
 
     def __enter__(self):
         return self

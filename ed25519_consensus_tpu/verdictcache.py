@@ -59,10 +59,20 @@ is structurally OFF the verdict math path:
   plumbing.  The process-default instance companions the process-
   default devcache (resolved live); a federation replica's namespaced
   instance companions its replica devcache.  A lane death/abandonment
-  additionally bumps the default instance's epoch through the
-  `health.register_residency_drop_listener` hook — deliberately
-  conservative: a device whose memory we no longer trust also forfeits
-  the memo store built while it participated.
+  additionally forfeits the default instance's device-trust-derived
+  state through the `health.register_residency_drop_listener` hook
+  (`forfeit_device_trust`): memoized ACCEPTS — which may embed the
+  distrusted device's arithmetic — are dropped and the epoch bumps
+  (refusing every in-flight store), while host-confirmed REJECTS ride
+  through re-pinned, because the scheduler re-decides every device
+  reject on the host before it can become a verdict.
+* **Persistence (persist.py).**  A `VerdictJournal` may be attached
+  (`attach_journal`): every landed store write-throughs an append-only
+  self-sealed record, and recovery re-admits records ONLY through
+  `absorb_entry` — the same payload+seal re-hash gate as a live hit,
+  re-pinned under the live epoch regime.  A loaded entry is just a
+  cache-hit candidate; a corrupt disk can cost warmth, never a
+  verdict.
 * **Budget + deterministic LRU + tenant quotas.**  Byte-budgeted
   (`ED25519_TPU_VERDICT_CACHE_BYTES`, host bytes of stored payloads),
   strict least-recently-used eviction in lookup order, and — with
@@ -208,8 +218,17 @@ class VerdictCache:
             # never memoize.
             "quota_rejected": 0, "budget_rejected": 0,
             "tenant_rotations": 0,
+            # The persistence surface (persist.py): absorbed counts
+            # journal records re-admitted through the recovery gate,
+            # absorb_refused the ones the gate turned away; forfeits
+            # counts accept entries dropped by forfeit_device_trust.
+            "absorbed": 0, "absorb_refused": 0, "forfeits": 0,
         }
         self._tenant_counters: "dict[str, dict]" = {}
+        # Write-through journal (persist.VerdictJournal), attached by
+        # persist.attach AFTER recovery loaded — None means the store
+        # is process-lifetime only (persistence disabled).
+        self._journal = None
 
     # -- companions / epochs ----------------------------------------------
 
@@ -273,6 +292,18 @@ class VerdictCache:
         return (self.epoch, self.tenant_epoch_of(tenant),
                 comp.epoch if comp is not None else 0,
                 comp.tenant_epoch_of(tenant) if comp is not None else 0)
+
+    def attach_journal(self, journal) -> None:
+        """Register a persist.VerdictJournal for write-through appends
+        (persist.attach calls this AFTER recovery loaded, so nothing
+        absorbed from disk is ever re-appended)."""
+        with self._lock:
+            self._journal = journal
+
+    def journal(self):
+        """The attached journal, or None (persistence off)."""
+        with self._lock:
+            return self._journal
 
     def drop_all(self, reason: str = "dropped") -> int:
         """Drop every stored verdict NOW (replica ejection, evict-storm
@@ -482,6 +513,7 @@ class VerdictCache:
             return False
         evicted = 0
         stored = False
+        landed = None
         key = (digest, tenant)
         with self._lock:
             def add_bytes(t, delta):
@@ -498,6 +530,7 @@ class VerdictCache:
                 del self._entries[key]
                 self._entries[key] = entry
                 add_bytes(tenant, entry.nbytes - existing.nbytes)
+                landed = entry
             else:
                 if quota > 0:
                     # Cross-tenant eviction is off the table: if OTHER
@@ -523,6 +556,7 @@ class VerdictCache:
                     self._entries[key] = entry
                     add_bytes(tenant, entry.nbytes)
                     stored = True
+                    landed = entry
 
                     def evict_own() -> bool:
                         # Dict order is recency: the first matching
@@ -556,8 +590,183 @@ class VerdictCache:
                     self._tenant_tally_locked(tenant, "stores")
         if evicted:
             _metrics.record_fault("verdictcache_evict", evicted)
+        if landed is not None:
+            # Write-through persistence (persist.py), OUTSIDE the
+            # cache lock: the in-memory insert already happened, and a
+            # failed append costs durability of one record, never the
+            # store (append swallows its own I/O errors).
+            journal = self.journal()
+            if journal is not None:
+                journal.append(landed)
         self._publish()
         return stored
+
+    # -- persistence surface (persist.py; recovery is NOT a verdict) -------
+
+    def export_entries(self) -> "list[VerdictEntry]":
+        """Sanctioned snapshot of the live entries in recency order
+        (oldest first) — journal compaction and the warm-export paths
+        read THIS, never the raw map (CL007: `_entries` outside this
+        module bypasses the re-hash discipline; an exported entry is
+        only ever re-admitted through `absorb_entry`'s gate)."""
+        with self._lock:
+            return list(self._entries.values())
+
+    def absorb_entry(self, digest: bytes, payload: bytes, verdict: bool,
+                     *, seal: "bytes | None" = None,
+                     tenant: "str | None" = None,
+                     writer_cls: str = _tenancy.CLASS_MEMPOOL) -> bool:
+        """The RECOVERY write path (persist.load_into): absorb one
+        journal-loaded record as a cache-hit CANDIDATE.  The same
+        consensus gate as a live hit runs before anything is inserted
+        — the payload must re-hash to the digest, and with the on-disk
+        `seal` given the stored verdict must still derive it (without
+        that check a flipped verdict byte on disk would quietly
+        re-seal itself here).  Survivors are pinned under the LIVE
+        epoch regime (`epoch_pins`): recovery chooses warmth, never
+        answers — a loaded entry is served exactly like any other hit,
+        through `lookup`'s unconditional re-hash.  Never journals
+        (absorbing a record must not re-append it), and applies the
+        same budget/quota/LRU discipline as `store` — a live entry
+        under the same key outranks the disk and only refreshes
+        recency."""
+        if not self.enabled:
+            return False
+        payload = bytes(payload)
+        verdict = bool(verdict)
+        if hashlib.sha256(payload).digest() != digest or (
+                seal is not None
+                and verdict_seal(digest, verdict) != seal):
+            with self._lock:
+                self.counters["rehash_mismatch"] += 1
+                self.counters["absorb_refused"] += 1
+            _metrics.record_fault("verdictcache_absorb_refused")
+            self._publish()
+            return False
+        tenant = tenant if tenant is not None else _tenancy.DEFAULT_TENANT
+        pins = self.epoch_pins(tenant)
+        entry = VerdictEntry(
+            digest, payload, verdict, pins[0], tenant=tenant,
+            tenant_epoch=pins[1], companion_epoch=pins[2],
+            companion_tenant_epoch=pins[3], writer_cls=writer_cls)
+        quota = self.tenant_quota_bytes
+        refused = entry.nbytes > self.budget_bytes or (
+            quota > 0 and entry.nbytes > quota)
+        evicted = 0
+        absorbed = False
+        key = (digest, tenant)
+        if not refused:
+            with self._lock:
+                def add_bytes(t, delta):
+                    self._resident_bytes += delta
+                    self._tenant_bytes[t] = \
+                        self._tenant_bytes.get(t, 0) + delta
+
+                existing = self._entries.get(key)
+                if existing is not None:
+                    # Live state outranks the disk: whatever is in
+                    # memory is at least as fresh as its journal
+                    # record — refresh recency only.
+                    del self._entries[key]
+                    self._entries[key] = existing
+                else:
+                    if quota > 0:
+                        other = self._resident_bytes \
+                            - self._tenant_bytes.get(tenant, 0)
+                        if other + entry.nbytes > self.budget_bytes:
+                            refused = True
+                    if not refused:
+                        self._entries[key] = entry
+                        add_bytes(tenant, entry.nbytes)
+                        absorbed = True
+
+                        def evict_own() -> bool:
+                            # Same walk as store(): dict order is
+                            # recency, quota keeps eviction inside the
+                            # absorbing tenant's own partition.
+                            for k2, e2 in self._entries.items():
+                                if k2 == key:
+                                    continue
+                                if quota > 0 and e2.tenant != tenant:
+                                    continue
+                                del self._entries[k2]
+                                add_bytes(e2.tenant, -e2.nbytes)
+                                self.counters["evictions"] += 1
+                                self._tenant_tally_locked(
+                                    e2.tenant, "evictions")
+                                return True
+                            return False
+
+                        if quota > 0:
+                            while (self._tenant_bytes.get(tenant, 0)
+                                   > quota and evict_own()):
+                                evicted += 1
+                        while self._resident_bytes > self.budget_bytes \
+                                and evict_own():
+                            evicted += 1
+                        self.counters["absorbed"] += 1
+        if refused:
+            with self._lock:
+                self.counters["absorb_refused"] += 1
+        if evicted:
+            _metrics.record_fault("verdictcache_evict", evicted)
+        self._publish()
+        return absorbed
+
+    def forfeit_device_trust(self, reason: str = "lane-death") -> int:
+        """Lane death / residency abandonment (the health residency-
+        drop listener): forfeit exactly the DEVICE-TRUST-DERIVED half
+        of the store.  The asymmetry is the scheduler's own ladder
+        (faults.py soundness note): a device REJECT is re-decided on
+        the host before it can ever become a verdict, so a memoized
+        reject is host-confirmed math and SURVIVES — re-pinned under
+        the post-bump epoch; a memoized ACCEPT may embed the now-
+        distrusted device's arithmetic and is dropped.  The global
+        epoch still bumps either way, so in-flight decisions admitted
+        under the old regime are refused at store time
+        (`expected_pins`) — the bump forfeits in-flight trust, the
+        drop forfeits stored accepts, and both leave host-confirmed
+        rejects serving (their bytes and seal are still re-checked on
+        every hit).  Only entries CURRENT at forfeit time are
+        re-pinned — an entry already staled by an earlier bump or
+        rotation must not be resurrected by the ride-through.  Returns
+        the number of accept entries dropped."""
+        # Companion epochs are read OUTSIDE self._lock (lookup's rule:
+        # the companion has its own lock; never nest them).
+        comp = self._companion_cache()
+        comp_epoch = comp.epoch if comp is not None else 0
+        with self._lock:
+            tenants = {e.tenant for e in self._entries.values()}
+        comp_tenant = {t: (comp.tenant_epoch_of(t)
+                           if comp is not None else 0) for t in tenants}
+        dropped = 0
+        with self._lock:
+            old = self._epoch
+            self._epoch += 1
+            for key, e in list(self._entries.items()):
+                if e.verdict:
+                    del self._entries[key]
+                    self._resident_bytes -= e.nbytes
+                    self._tenant_bytes[e.tenant] = \
+                        self._tenant_bytes.get(e.tenant, 0) - e.nbytes
+                    dropped += 1
+                elif (e.epoch == old
+                        and e.tenant_epoch
+                        == self._tenant_epoch.get(e.tenant, 0)
+                        and e.companion_epoch == comp_epoch
+                        and e.companion_tenant_epoch
+                        == comp_tenant.get(e.tenant, 0)):
+                    e.epoch = self._epoch
+                # else: already stale under some OTHER pin — leave it;
+                # the next lookup drops it as stale_epoch.
+            self.counters["drops"] += dropped
+            self.counters["forfeits"] += dropped
+        _metrics.record_fault("verdictcache_epoch_bump")
+        if dropped:
+            _metrics.record_fault("verdictcache_device_trust_forfeit",
+                                  dropped)
+        self._publish()
+        return dropped
 
     # -- observability -----------------------------------------------------
 
@@ -640,15 +849,18 @@ def set_default_cache(cache: "VerdictCache | None") -> None:
         _default[0] = cache
 
 
-# Lane death / abandonment bumps the default store's epoch: memoized
-# verdicts decided while a now-distrusted device participated are
-# conservatively forfeited and re-decided on demand (same listener
-# contract as devcache's drop_all — runs OUTSIDE health's lock).
+# Lane death / abandonment forfeits the default store's DEVICE-TRUST-
+# DERIVED state (forfeit_device_trust): memoized accepts decided while
+# a now-distrusted device participated are dropped and re-decided on
+# demand; host-confirmed rejects ride through, re-pinned — and the
+# epoch bump still refuses every in-flight decision at store time
+# (same listener contract as devcache's drop_all — runs OUTSIDE
+# health's lock).
 def _on_residency_drop(reason: str) -> None:
     with _default_lock:
         cache = _default[0]
     if cache is not None:
-        cache.bump_epoch(reason)
+        cache.forfeit_device_trust(reason)
 
 
 _health.register_residency_drop_listener(_on_residency_drop)

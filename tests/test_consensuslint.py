@@ -295,6 +295,8 @@ def test_real_tenancy_and_traffic_lab_lint_clean():
         os.path.join(linter.REPO_ROOT, "tools", "mesh_chaos.py"),
         os.path.join(linter.REPO_ROOT, "tools", "sentinel_soak.py"),
         os.path.join(linter.REPO_ROOT, "tools", "replay_lab.py"),
+        os.path.join(linter.PACKAGE_ROOT, "persist.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "restart_lab.py"),
     ]
     findings = linter.lint_paths(paths)
     assert findings == [], [str(f) for f in findings]
@@ -430,6 +432,83 @@ def test_cl007_replay_lab_in_scope():
                                       src)) == ["CL007"]
 
 
+def test_cl007_persist_in_scope_write_inside_verdict_symbol():
+    """persist.py is recovery surface, never verdict surface: a store
+    reachable from verdict aggregation inside it is rejected like
+    anywhere else."""
+    src = ("def verify_many(vs, vcache):\n"
+           "    verdicts = [decide(v) for v in vs]\n"
+           "    vcache.store(vs[0], verdicts[0])\n"
+           "    return verdicts\n")
+    assert rules_of(lint_fixture("persist.py", src)) == ["CL007"]
+
+
+def test_cl007_persist_raw_entry_read_rejected():
+    """Recovery must go through export_entries/absorb_entry — a raw
+    `_entries` read would bypass the per-hit re-hash."""
+    src = ("def load_into(vcache):\n"
+           "    for d, e in vcache._entries.items():\n"
+           "        serve(d, e.verdict)\n")
+    findings = lint_fixture("persist.py", src)
+    assert rules_of(findings) == ["CL007"]
+    assert "re-hash" in findings[0].message
+
+
+def test_cl007_positive_persist_recovery_surface():
+    """The shipped shape: the journal reads via export_entries and
+    writes via absorb_entry (which re-verifies) — clean."""
+    src = ("def compact(journal, vcache):\n"
+           "    return [e.digest for e in vcache.export_entries()]\n"
+           "def load_into(vcache, recs):\n"
+           "    for r in recs:\n"
+           "        vcache.absorb_entry(r.digest, r.payload,\n"
+           "                            r.verdict, seal=r.seal)\n")
+    assert lint_fixture("persist.py", src) == []
+
+
+def test_cl007_restart_lab_in_scope():
+    src = ("def verify_many(vs, memo_cache):\n"
+           "    verdicts = [decide(v) for v in vs]\n"
+           "    memo_cache.put(vs[0], verdicts[0])\n"
+           "    return verdicts\n")
+    assert rules_of(lint_tool_fixture("tools/restart_lab.py",
+                                      src)) == ["CL007"]
+
+
+def test_cl004_negative_persist_module_global_journal():
+    """The journal is an injectable object attached to its cache —
+    a module-global journal registry would be ambient cross-cache
+    durability state, exactly what CL004 rejects."""
+    findings = lint_fixture("persist.py", "_open_journals = {}\n")
+    assert rules_of(findings) == ["CL004"]
+    assert "_open_journals" in findings[0].message
+
+
+def test_cl006_negative_persist_overbroad_except():
+    """Recovery fail-open must still name its failure modes: a
+    swallow-all around the load path is rejected — the shipped code
+    catches (OSError, InjectedFault) specifically."""
+    src = ("def append(journal, entry):\n"
+           "    try:\n"
+           "        journal.write(entry)\n"
+           "    except Exception:\n"
+           "        return False\n")
+    assert rules_of(lint_fixture("persist.py", src)) == ["CL006"]
+
+
+def test_cl003_negative_restart_lab_raw_environ():
+    src = ("import os\n"
+           "SEED = os.environ.get('ED25519_TPU_RESTART_LAB_SEED')\n")
+    assert "CL003" in rules_of(
+        lint_tool_fixture("tools/restart_lab.py", src))
+
+
+def test_cl004_negative_restart_lab_module_global():
+    findings = lint_tool_fixture("tools/restart_lab.py",
+                                 "_warm_state = {}\n")
+    assert rules_of(findings) == ["CL004"]
+
+
 def test_cl004_negative_verdictcache_module_global_store():
     """The old-batch.py-cache shape rejected in verdictcache.py too:
     the memo store is an injectable object behind the allowlisted
@@ -459,7 +538,9 @@ def test_real_service_and_verdictcache_hold_cl007():
         os.path.join(linter.PACKAGE_ROOT, "service.py"),
         os.path.join(linter.PACKAGE_ROOT, "federation.py"),
         os.path.join(linter.PACKAGE_ROOT, "verdictcache.py"),
+        os.path.join(linter.PACKAGE_ROOT, "persist.py"),
         os.path.join(linter.REPO_ROOT, "tools", "replay_lab.py"),
+        os.path.join(linter.REPO_ROOT, "tools", "restart_lab.py"),
     ]
     findings = [f for f in linter.lint_paths(paths)
                 if f.rule == "CL007"]
@@ -844,14 +925,14 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 42 knobs (38 through the
-    round-11 federation work + the four round-12 verdict-memoization
-    knobs: the verdict-cache enable opt-out, its byte budget, its
-    per-tenant quota, and the replay-lab seed)."""
+    these rows) and the registry knows all 46 knobs (42 through the
+    round-12 verdict-memoization work + the four durable-verdict-state
+    knobs: the journal directory, its fsync policy, its compaction
+    size bound, and the restart-lab seed)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 42
+    assert len(rows) == len(config.KNOBS) == 46
     assert all(doc for (_, _, _, doc) in rows)
     for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
                  "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
@@ -878,7 +959,11 @@ def test_config_registry_covers_readme_table():
                  "ED25519_TPU_VERDICT_CACHE_ENABLED",
                  "ED25519_TPU_VERDICT_CACHE_BYTES",
                  "ED25519_TPU_VERDICT_CACHE_TENANT_QUOTA",
-                 "ED25519_TPU_REPLAY_LAB_SEED"):
+                 "ED25519_TPU_REPLAY_LAB_SEED",
+                 "ED25519_TPU_PERSIST_DIR",
+                 "ED25519_TPU_PERSIST_FSYNC",
+                 "ED25519_TPU_PERSIST_MAX_BYTES",
+                 "ED25519_TPU_RESTART_LAB_SEED"):
         assert name in config.KNOBS
 
 

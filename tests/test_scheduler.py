@@ -639,7 +639,11 @@ def test_merge_does_not_mutate_members():
 def test_warm_device_shapes_compiles_scheduler_shapes(monkeypatch):
     """warm_device_shapes must dispatch exactly ONE batch shape — the
     full (chunk, N) every scheduler dispatch (probe included) is padded
-    to — and never raise on failure.  With the devcache enabled it
+    to — and never raise on failure.  (Not a slow-mark candidate: the
+    devcache-on half compiles the hot-path executable IN-PROCESS, and
+    the file's later lane-lifecycle tests plus test_sentinel's
+    transient-retry tests lean on that warmth — deselecting it makes
+    them deadline-flaky on a loaded box.)  With the devcache enabled it
     additionally warms the hot-path executable, whose on-device
     assemble feeds the SAME inner kernel dispatch once more (ops/msm
     dispatch_window_sums_many_cached), still at the full chunk."""

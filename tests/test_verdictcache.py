@@ -809,17 +809,47 @@ def test_quota_suggestions_report_only_and_knob_gated(monkeypatch):
 # -- residency-drop conservatism -------------------------------------------
 
 
-def test_lane_death_forfeits_default_memo_store():
-    """The health residency-drop listener: a lane marked stuck bumps
-    the DEFAULT verdict cache's epoch — memoized verdicts decided
-    while a now-distrusted device participated are re-decided."""
+def test_lane_death_forfeits_device_trust_not_host_rejects():
+    """The health residency-drop listener, refined (this round): a
+    lane marked stuck forfeits DEVICE-derived trust only.  Memoized
+    ACCEPTs may embed the distrusted device's arithmetic — dropped.
+    Memoized REJECTs were host-confirmed before they could become
+    verdicts (the device-reject host re-verify), so they carry no
+    device trust: they survive, re-pinned to the bumped epoch."""
     verdictcache.set_default_cache(None)
     vc = verdictcache.default_cache()
-    v = verifier_for(b"lane")
-    vc.store(v, True)
-    assert vc.lookup(v.content_digest()) is not None
+    acc = verifier_for(b"lane")
+    rej = verifier_for(b"lane-rej", bad=True)
+    vc.store(acc, True)
+    vc.store(rej, False)
+    assert vc.lookup(acc.content_digest()) is not None
+    assert vc.lookup(rej.content_digest()) is not None
     before = vc.epoch
     health.notify_residency_drop("test lane death")
     assert vc.epoch == before + 1
-    assert vc.lookup(v.content_digest()) is None
+    # Accept: device trust forfeited — gone, full re-verify next time.
+    assert vc.lookup(acc.content_digest()) is None
+    # Reject: host-confirmed — still served, under the NEW epoch.
+    hit = vc.lookup(rej.content_digest())
+    assert hit is not None and hit.verdict is False
+    assert hit.epoch == vc.epoch
+    assert vc.counters["forfeits"] == 1
     verdictcache.set_default_cache(None)
+
+
+def test_forfeit_skips_entries_already_stale():
+    """forfeit_device_trust must not resurrect a reject whose pins
+    were ALREADY stale (e.g. staled by a companion tenant rotation
+    before the lane died): only currently-live rejects re-pin."""
+    devc = devcache.DeviceOperandCache(budget_bytes=1 << 16,
+                                       enabled=True)
+    vc = make_cache(companion=devc)
+    live = verifier_for(b"ff-live", bad=True)
+    stale = verifier_for(b"ff-stale", bad=True)
+    vc.store(live, False, tenant="t-live")
+    vc.store(stale, False, tenant="t-rot")
+    devc.rotate_tenant("t-rot", "validator-set change")
+    vc.forfeit_device_trust(reason="test lane death")
+    hit = vc.lookup(live.content_digest(), tenant="t-live")
+    assert hit is not None and hit.verdict is False
+    assert vc.lookup(stale.content_digest(), tenant="t-rot") is None
