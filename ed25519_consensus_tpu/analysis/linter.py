@@ -79,6 +79,18 @@ _CL001_BATCH_SYMBOLS = (
     "_resolve_union", "verify_single_many", "PendingVerification",
 )
 
+# CL001 scope inside health.py (round 18): the latency ledger and its
+# registry entry point.  Latency evidence gates placement and timing,
+# never verdict math — but the EVIDENCE itself must still be exact:
+# durations are bucketed to integer µs at the recording boundary and
+# every quantile/gate comparison runs in scaled integers, so detection
+# is bit-identical across hosts.  Float latency math inside these
+# symbols is a finding.  The rest of health.py (decay half-lives,
+# breaker EMAs) legitimately holds floats.
+_CL001_HEALTH_SYMBOLS = (
+    "LatencyLedger", "ChipRegistry.record_latency",
+)
+
 _FLOAT_DTYPES = frozenset(
     ("float16", "float32", "float64", "bfloat16", "float_"))
 
@@ -97,7 +109,7 @@ _CL004_MODULES = ("batch.py", "service.py", "health.py", "routing.py",
                   "federation.py", "verdictcache.py", "persist.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
                   "tools/sentinel_soak.py", "tools/replay_lab.py",
-                  "tools/restart_lab.py")
+                  "tools/restart_lab.py", "tools/straggler_lab.py")
 _CL004_ALLOWED = {
     "batch.py": frozenset((
         "_shift128_cache", "_key_row_cache", "_host_split_cache",
@@ -134,7 +146,7 @@ _CL006_MODULES = ("batch.py", "service.py", "tenancy.py",
                   "federation.py", "verdictcache.py", "persist.py",
                   "tools/traffic_lab.py", "tools/mesh_chaos.py",
                   "tools/sentinel_soak.py", "tools/replay_lab.py",
-                  "tools/restart_lab.py")
+                  "tools/restart_lab.py", "tools/straggler_lab.py")
 _CL005_SECRET_ATTRS = frozenset(("s", "prefix"))
 _CL005_SECRET_CALLS = frozenset(("to_bytes", "__bytes__"))
 
@@ -252,15 +264,18 @@ def _check_cl001(mod: ParsedModule):
     rel = _pkg_rel(mod.relpath)
     in_scope_module = rel.startswith("ops/") or rel.startswith("parallel/")
     is_batch = rel == "batch.py"
-    if not (in_scope_module or is_batch):
+    is_health = rel == "health.py"
+    if not (in_scope_module or is_batch or is_health):
         return
 
     def scoped(node) -> bool:
         if in_scope_module:
             return True
+        syms = (_CL001_HEALTH_SYMBOLS if is_health
+                else _CL001_BATCH_SYMBOLS)
         sym = mod.symbol_of(node)
         return any(sym == s or sym.startswith(s + ".")
-                   for s in _CL001_BATCH_SYMBOLS)
+                   for s in syms)
 
     for node in mod.walk():
         if not scoped(node):
@@ -540,7 +555,8 @@ def _check_cl006(mod: ParsedModule):
 # published — is pinned by the CorruptStoredVerdict fault tests.
 _CL007_MODULES = ("batch.py", "service.py", "verdictcache.py",
                   "federation.py", "persist.py",
-                  "tools/replay_lab.py", "tools/restart_lab.py")
+                  "tools/replay_lab.py", "tools/restart_lab.py",
+                  "tools/straggler_lab.py")
 _CL007_VERDICT_SYMBOLS = (
     "verify_many", "_host_verdict", "_resolve_union",
     "verify_single_many", "Verifier.verify", "VerifyService._execute",

@@ -1605,7 +1605,8 @@ class _DeviceLane:
                     # corruption/lane-death faults land.
                     out = np.asarray(_faults.run_device_call(
                         _faults.SITE_LANE, _call, mesh=self._mesh,
-                        clock=clock))
+                        clock=clock,
+                        payload=self._device_ids))
                 # Fetch done ⇒ any first-compile for this shape is over:
                 # subsequent calls are held to the normal deadline.  Each
                 # cached dispatch form is a DIFFERENT executable at the
@@ -1891,6 +1892,14 @@ _OutstandingChunk = _collections.namedtuple(
     "_OutstandingChunk",
     ("cid", "idxs", "t0", "padded_b", "n_lanes", "variant", "staged"))
 
+# Hedging (round 18) arms only once the ledger's cross-placement wave
+# ring holds this many recent dispatches: below it the HEDGE_QUANTILE
+# tail is statistically meaningless and the threshold would collapse to
+# the bare HEDGE_MIN_MS floor, hedging healthy-but-cold waves.  A
+# quarter of the ring (LatencyLedger.WAVE_WINDOW = 128) — services
+# cross it within their first few waves.
+_HEDGE_ARM_WAVES = 32
+
 
 def _sentinel_fires(rate: float, ordinal: int) -> bool:
     """Deterministic sampled-audit draw: pure function of the cold
@@ -1991,7 +2000,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 mesh: int | None = None,
                 health: "DeviceHealth | None" = None,
                 policy: "_routing.RoutingPolicy | None" = None,
-                sentinel_rate: "float | None" = None
+                sentinel_rate: "float | None" = None,
+                deadline: "float | None" = None,
+                device_ids: "tuple | None" = None
                 ) -> "list[bool]":
     """Verify MANY independent batches with union-merging, chunked
     double-buffered device calls, and an opportunistic host lane.
@@ -2044,7 +2055,28 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     quarantine ladder).  A chunk whose audit diverges is DISTRUSTED:
     every one of its batches is re-decided by the exact host path
     before any verdict publishes — the audit itself never touches the
-    math."""
+    math.
+
+    `deadline` (round 18; absolute time on the health clock) is the
+    caller's latest-useful moment — the hedge machinery consults it
+    for affordability (a hedge twin only fires while the deadline
+    still affords deciding something) and nothing else: verify_many
+    never sheds work on it.  `device_ids` is an explicit placement
+    override (e.g. the straggler lab's forced-device sweeps, which
+    need per-chip latency attribution): the dispatch runs on exactly
+    these chips unless one of them is excluded, in which case the
+    ordinary entry reformation applies.
+
+    Hedged re-dispatch (round 18): an outstanding chunk whose device
+    call outlives the ledger-derived hedge threshold
+    (ED25519_TPU_HEDGE_QUANTILE of recent wave durations, floored at
+    ED25519_TPU_HEDGE_MIN_MS) gets a HOST TWIN that re-verifies its
+    undecided batches with fresh blinders; first valid result wins
+    through the same `decided` ledger every lane already races on, and
+    the loser is discarded UNREAD.  Hedging changes placement and
+    timing, never math — device accepts still ride the sentinel
+    regime, device rejects still host-confirm, and a hedge pair never
+    mixes partial results (re-verification, not result transfer)."""
     from .ops import msm
 
     # Wall-clock for the per-call `seconds` stat only (scheduling time
@@ -2073,7 +2105,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             union_verdicts = verify_many(
                 unions, rng=rng, chunk=chunk, hybrid=hybrid,
                 merge="never", mesh=mesh, health=health, policy=policy,
-                sentinel_rate=sentinel_rate
+                sentinel_rate=sentinel_rate, deadline=deadline,
+                device_ids=device_ids
             )
             stats = dict(last_run_stats)
             verdicts = [False] * len(verifiers)
@@ -2141,11 +2174,15 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
     if sentinel_rate is None:
         sentinel_rate = _config.get("ED25519_TPU_SENTINEL_RATE")
     sentinel_rate = float(sentinel_rate)
-    device_ids = None
+    forced_ids = bool(device_ids)
+    device_ids = tuple(int(c) for c in device_ids) if device_ids else None
     entry_reform = None
     no_device_rung = False
-    if (not _config.get("ED25519_TPU_DISABLE_DEVICE")
-            and _health.chip_registry().excluded_chips()):
+    _entry_excl = (frozenset()
+                   if _config.get("ED25519_TPU_DISABLE_DEVICE")
+                   else _health.chip_registry().excluded_chips())
+    if _entry_excl and (not forced_ids
+                        or _entry_excl & set(device_ids)):
         # excluded = dead ∪ quarantined ∪ probation (round 10): a
         # quarantined chip reforms placement exactly like a lost one.
         rung, device_ids = _routing.reform_for(mesh if mesh else 1)
@@ -2203,6 +2240,16 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                           _health.ERROR_FATAL: 0,
                           _health.ERROR_AMBIGUOUS: 0},
         "transient_retries": 0,
+        # Gray-failure trail (round 18): hedge pairs fired/won/lost and
+        # straggler-streak suspicion accruals attributed this call.  A
+        # hedge "wins" when the host twin decided at least one of the
+        # pair's batches (or the device leg never produced a usable
+        # result); it "loses" when the device landed first everywhere
+        # and the twin's budget slot simply returns.
+        "hedges_fired": 0,
+        "hedges_won": 0,
+        "hedges_lost": 0,
+        "straggler_suspicion_events": 0,
         # Sentinel-audit trail (round 10): audited chunk count,
         # divergences, and the chips divergence attributed.
         "sentinel": {"rate": sentinel_rate, "audits": 0,
@@ -2494,6 +2541,141 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             return tuple(device_ids)
         return tuple(range(mesh)) if mesh and mesh > 1 else (0,)
 
+    def _record_chunk_latency(call_dt):
+        """Land one completed dispatch duration in the latency ledger
+        (round 18), attributed over the current placement; straggler
+        streaks accrue suspicion inside the registry and surface in
+        this call's stats + metrics.  Timing METADATA only — the
+        verdict math never sees it."""
+        flagged = _health.chip_registry().record_latency(
+            _placement_chips(), call_dt)
+        if flagged:
+            stats["straggler_suspicion_events"] += len(flagged)
+            _metrics.record_fault("straggler_suspicion", len(flagged))
+
+    # Hedged re-dispatch (round 18).  Budget and threshold are resolved
+    # once per call; the threshold itself is re-derived per check from
+    # the ledger's live wave quantile (integer µs), floored at the
+    # HEDGE_MIN_MS knob — MIN_MS=0 force-hedges (lab/test knob),
+    # BUDGET=0 disables hedging entirely.  Hedging ARMS only once the
+    # wave ring is warm: a tail quantile over a handful of samples is
+    # noise, and with zero evidence the threshold would collapse to the
+    # bare floor — which a healthy-but-contended backend (the CPU mesh
+    # under CI load, a cold first wave) legitimately exceeds, so the
+    # twin would steal batches the device decides fine.  None = stay
+    # disarmed (the explicit MIN_MS=0 force-hedge knob bypasses).
+    hedge_budget = [max(0, int(_config.get("ED25519_TPU_HEDGE_BUDGET")))]
+    _hedge_q_milli = int(round(float(
+        _config.get("ED25519_TPU_HEDGE_QUANTILE")) * 1000))
+    _hedge_floor_s = float(_config.get("ED25519_TPU_HEDGE_MIN_MS")) / 1000.0
+    hedged = set()      # cids with an active host twin
+    hedge_wins = {}     # cid -> batches the twin decided so far
+
+    def _hedge_threshold_s() -> "float | None":
+        led = _health.chip_registry().latency
+        if _hedge_floor_s > 0 and led.wave_samples() < _HEDGE_ARM_WAVES:
+            return None
+        thr_us = led.wave_quantile_us(_hedge_q_milli)
+        return max(thr_us / 1000000.0, _hedge_floor_s)
+
+    def _hedge_until():
+        """Earliest moment an outstanding, un-hedged chunk crosses the
+        hedge threshold — bounds forced-device blocking waits so the
+        crossing is observed when it happens, not only after the
+        deadline budget expires.  None = nothing can fire (budget
+        spent, or everything outstanding already hedged)."""
+        if hedge_budget[0] <= 0:
+            return None
+        thr = _hedge_threshold_s()
+        if thr is None:
+            return None
+        best = None
+        for r2 in outstanding:
+            if r2.cid in hedged:
+                continue
+            t_start = dev.started_at(r2.cid)
+            t = (t_start if t_start is not None else r2.t0) + thr
+            if best is None or t < best:
+                best = t
+        return best
+
+    def _hedge_resolve(cid, twin_won: bool):
+        """Close one hedge pair's bookkeeping: budget slot back,
+        win/loss counters.  `twin_won` forces a win (the device leg
+        was abandoned, discarded, or errored — it never produced a
+        usable result, so the twin is the pair's only decider)."""
+        if cid not in hedged:
+            return
+        hedged.discard(cid)
+        hedge_budget[0] += 1
+        if twin_won or hedge_wins.pop(cid, 0):
+            hedge_wins.pop(cid, None)
+            stats["hedges_won"] += 1
+            _metrics.record_fault("hedge_won")
+        else:
+            stats["hedges_lost"] += 1
+            _metrics.record_fault("hedge_lost")
+
+    def maybe_hedge() -> bool:
+        """Fire and drive hedge twins; True when the twin decided a
+        batch this iteration (progress — the caller must not fall into
+        a blocking device wait on top of it).
+
+        Firing: each outstanding chunk whose device call has been in
+        flight past the hedge threshold claims a budget slot, oldest
+        chunk first — service waves coalesce consensus-class requests
+        earliest, so consensus hedges first.  A deadline-carrying call
+        only fires while the deadline still affords deciding at least
+        one more batch host-side (median host time).  Driving: ONE
+        host re-verification per scheduler iteration on the oldest
+        hedged chunk's undecided tail — incremental, so a device
+        result landing mid-hedge still wins every batch the twin has
+        not decided yet.  The twin re-stages with FRESH blinders
+        (host_verify_one → _host_verdict), and a pair's two legs never
+        mix: whichever leg decides a batch first owns that verdict
+        outright."""
+        if not outstanding or (hedge_budget[0] <= 0 and not hedged):
+            return False
+        t_now = now()
+        thr = _hedge_threshold_s() if hedge_budget[0] > 0 else None
+        if thr is not None:
+            t_host_med = (sorted(_host_times)[len(_host_times) // 2]
+                          if _host_times else 0.0)
+            for r2 in outstanding:
+                if hedge_budget[0] <= 0:
+                    break
+                if r2.cid in hedged:
+                    continue
+                t_start = dev.started_at(r2.cid)
+                base = t_start if t_start is not None else r2.t0
+                if t_now - base < thr:
+                    continue
+                if deadline is not None and t_now + t_host_med >= deadline:
+                    break  # the deadline no longer affords a twin
+                hedged.add(r2.cid)
+                hedge_budget[0] -= 1
+                stats["hedges_fired"] += 1
+                _metrics.record_fault("hedge_fired")
+        for ci in range(len(outstanding)):
+            r2 = outstanding[ci]
+            if r2.cid not in hedged:
+                continue
+            undecided = [i for i in r2.idxs if not decided[i]]
+            if not undecided:
+                continue
+            hedge_wins[r2.cid] = hedge_wins.get(r2.cid, 0) + 1
+            host_verify_one(undecided[0])
+            if len(undecided) == 1:
+                # The twin fully overtook the chunk: the device leg is
+                # the LOSER — its result is dropped on arrival by the
+                # lane, UNREAD (discard-before-read is the whole
+                # first-valid-wins discipline).
+                dev.discard(r2.cid)
+                outstanding.pop(ci)
+                _hedge_resolve(r2.cid, True)
+            return True
+        return False
+
     def _sentinel_check(rec, folded, partials) -> bool:
         """Audit one audited chunk (read-only recomputation): sample a
         batch and a shard, host-recompute that shard's partial from
@@ -2659,9 +2841,11 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             cid, idxs, now(), padded_b, n_lanes, variant,
             (digits, pts) if audit else None))
 
-    def poll(block: bool):
+    def poll(block: bool, until: "float | None" = None):
         """Apply finished chunk results; returns True if progress.  On a
-        deadline miss, fail the device over to the host."""
+        deadline miss, fail the device over to the host.  `until`
+        (round 18) bounds a blocking wait short of the deadline budget
+        — the hedge machinery's wake-up, never a miss signal."""
         nonlocal device_sick, device_failed, ema_per_batch, \
             ema_is_prior, probed
         progress = False
@@ -2697,22 +2881,34 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 # very first chunk would dodge the miss machinery the
                 # service breaker feeds on.
                 while True:
+                    wait_end = deadline if until is None \
+                        else min(deadline, until)
                     res = dev.wait(
-                        cid, min(0.25, max(0.0, deadline - now())))
+                        cid, min(0.25, max(0.0, wait_end - now())))
                     if res is not _PENDING:
                         break
                     t_start = dev.started_at(cid)
                     if t_start is not None:
                         deadline = t_start + budget
+                    if until is not None and now() >= until:
+                        break
                     if now() >= deadline:
                         break
             else:
-                timeout = max(0.0, deadline - now()) if block else 0.0
+                wait_end = deadline if until is None \
+                    else min(deadline, until)
+                timeout = max(0.0, wait_end - now()) if block else 0.0
                 res = dev.wait(cid, timeout)
             if res is _PENDING:
                 t_start = dev.started_at(cid)
                 deadline = (t_start + budget) if t_start is not None \
                     else (t0 + budget + 10.0)
+                if until is not None and now() >= until \
+                        and now() < deadline:
+                    # Hedge-bound wake: the threshold crossed, nothing
+                    # missed its deadline — the caller's maybe_hedge
+                    # takes it from here.
+                    return progress
                 if now() < deadline:
                     return progress
                 health.note_deadline_miss()  # bench the FAILED rung
@@ -2720,6 +2916,10 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 dev.abandon()
                 undecided = [i for r2 in outstanding for i in r2.idxs
                              if not decided[i]]
+                for r2 in outstanding:
+                    # Abandoned device legs never produce a usable
+                    # result: any active twin is the pair's decider.
+                    _hedge_resolve(r2.cid, True)
                 outstanding.clear()
                 if try_reform(undecided):
                     # A chip died under the in-flight wave: the stall
@@ -2735,6 +2935,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 return True
             outstanding.pop(0)
             out, call_dt, err = res
+            # Hedge bookkeeping resolves the moment the device leg
+            # lands (win/loss is about WHO decided, checked below via
+            # hedge_wins — an errored leg is always a twin win).
+            was_hedged = cid in hedged
+            if was_hedged:
+                _hedge_resolve(cid, err is not None)
             if out is None:  # device error: classify, then act
                 stats["device_errors"] += 1
                 _metrics.record_fault("device_error")
@@ -2744,6 +2950,19 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 ev = _health.classify_device_error(err)
                 stats["error_classes"][ev.cls] += 1
                 undecided = [i for i in idxs if not decided[i]]
+                if ev.cls == _health.ERROR_TRANSIENT and was_hedged:
+                    # Hedged chunk: the hedge path and the retry path
+                    # are SEPARATE budgets — the twin already covers
+                    # these batches, so the error burns no
+                    # transient-retry budget and the undecided tail
+                    # decides host-side right now.  A later transient
+                    # error on an UN-hedged chunk still classifies and
+                    # retries exactly as before.
+                    _metrics.record_fault("hedge_device_error")
+                    for i in undecided:
+                        host_verify_one(i)
+                    progress = True
+                    continue
                 if (ev.cls == _health.ERROR_TRANSIENT
                         and transient_left[0] > 0 and not device_failed):
                     # transient → RETRY with bounded backoff: the
@@ -2798,6 +3017,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                     # are discarded, not waited for.
                     for r2 in outstanding:
                         old_dev.discard(r2.cid)
+                        _hedge_resolve(r2.cid, True)
                     outstanding.clear()
                     return True
                 device_failed = True  # don't trust an error turnaround as
@@ -2805,6 +3025,12 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 for i in idxs:
                     host_verify_one(i)
             else:
+                # A completed dispatch carries a measured call
+                # duration: feed the latency ledger (round 18) whatever
+                # the verdict path decides below — call_dt is timing
+                # METADATA, so a hedge loser's timing still counts even
+                # though its result contents stay unread.
+                _record_chunk_latency(call_dt)
                 if was_cached == 3:
                     # Audited sharded chunk (round 10): the result is
                     # [folded, per-shard partials].  Run the sentinel
@@ -2835,6 +3061,7 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                             if try_reform(inflight):
                                 for r2 in outstanding:
                                     old_dev.discard(r2.cid)
+                                    _hedge_resolve(r2.cid, True)
                                 outstanding.clear()
                                 return True
                             # No reformable rung left (or budget
@@ -2920,6 +3147,11 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
             # device call of chunk i or the lane serializes.
             submit()
         poll(block=False)
+        # Hedge machinery (round 18): fire twins for threshold-crossed
+        # chunks and drive at most one twin re-verification per
+        # iteration — progress here must skip the blocking waits below
+        # (first-valid-wins needs both legs actually racing).
+        hedge_progress = maybe_hedge()
         # Non-hybrid callers still get the host lane WHILE an unmeasured
         # cold-shape call is in flight: that call may be a minutes-long
         # first compile (grace budget in poll), and parking every batch
@@ -2936,6 +3168,8 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
         if lane_hybrid and remaining and outstanding:
             host_verify_one(remaining.pop())
         elif outstanding:
+            if hedge_progress:
+                continue  # the twin's decision was this iteration's work
             if lane_hybrid:
                 # Nothing left in the pool: RACE the in-flight chunks —
                 # re-verify their batches on the host (last chunk first,
@@ -2989,6 +3223,9 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                                     resolved = True
                             if not resolved:
                                 dev.discard(cid)
+                                _hedge_resolve(cid, True)
+                            else:
+                                _hedge_resolve(cid, False)
                             outstanding.pop(ci)
                         break
                 if not stole:
@@ -2996,7 +3233,10 @@ def verify_many(verifiers, rng=None, chunk: int = 8,
                 else:
                     poll(block=False)
             else:
-                poll(block=True)
+                # Forced-device: block, but only up to the next hedge
+                # threshold crossing — a blocking wait must not sleep
+                # through the moment the hedge machinery would fire.
+                poll(block=True, until=_hedge_until())
         elif remaining:
             host_verify_one(remaining.pop())
     return _finish(verdicts)
@@ -3132,10 +3372,21 @@ def run_probation_probe(verifier, chip: int, rng=None) -> "bool | None":
         d, p = staged.device_operands(lambda n: pad)
         import jax
 
-        with msm.DEVICE_CALL_LOCK:
+        def _probe_call():
             with jax.default_device(jax.devices()[int(chip)]):
-                out = np.asarray(
+                return np.asarray(
                     msm.dispatch_window_sums_many(d[None], p[None]))
+
+        # Timed on the registry clock and routed through the fault
+        # seam (payload = the probed chip), so the probe measures the
+        # same per-chip latency the production lane would see — the
+        # round-18 latency gate below reads this duration.
+        with msm.DEVICE_CALL_LOCK:
+            t_probe = reg.clock.monotonic()
+            out = np.asarray(_faults.run_device_call(
+                _faults.SITE_LANE, _probe_call, mesh=0,
+                clock=reg.clock, payload=(int(chip),)))
+            probe_dt = reg.clock.monotonic() - t_probe
         got = msm.combine_window_sums(out[0])
     except Exception:
         # Probe supervision: any failure to produce a comparable sum IS
@@ -3145,6 +3396,17 @@ def run_probation_probe(verifier, chip: int, rng=None) -> "bool | None":
         _metrics.record_fault("probation_probe_failed")
         return False
     if got == expected:
+        if not reg.latency.within_gate(probe_dt):
+            # Round 18: probation has a LATENCY gate on top of the
+            # correctness gate — a chip can compute perfectly and
+            # still be the mesh's gray failure.  A correct-but-slow
+            # probe (over ratio × mesh median) is a FAIL: back to
+            # quarantine; rejoin waits for the chip to be fast again.
+            reg.record_probation_fail(
+                chip, weight=_health.STRAGGLER_SUSPICION,
+                reason="probation probe over latency gate")
+            _metrics.record_fault("probation_probe_latency_failed")
+            return False
         rejoined = reg.record_probation_pass(chip)
         _metrics.record_fault("probation_probe_passed")
         if rejoined:

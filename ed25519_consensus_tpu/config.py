@@ -44,12 +44,13 @@ Knob type conventions:
   empty value returns ``""`` (some knobs treat "" as an opt-out).
 """
 
+import contextlib
 import os
 
 from .error import ConfigError
 
 __all__ = ["ConfigError", "Knob", "KNOBS", "get", "get_raw",
-           "validate_all", "knob_table"]
+           "override", "validate_all", "knob_table"]
 
 _OPT_IN_TRUE = ("1", "true", "yes")
 _OPT_OUT_FALSE = ("0", "false", "no")
@@ -306,6 +307,41 @@ KNOBS: "dict[str, Knob]" = dict([
        "scenario: the replayed workload, the mid-traffic crash point, "
        "and the persistence-storm fault windows (the run is a pure "
        "function of it)."),
+    _k("ED25519_TPU_STRAGGLER_RATIO", "float", 3.0,
+       "Relative-straggler rule: a chip whose recent p90 dispatch "
+       "latency exceeds this ratio times the mesh-wide median (for "
+       "ED25519_TPU_STRAGGLER_MIN_SAMPLES consecutive dispatches) "
+       "accrues STRAGGLER_SUSPICION; also scales the probation "
+       "latency gate.  The comparison runs in scaled integers inside "
+       "health.LatencyLedger — this knob is collapsed to per-mille "
+       "once at read."),
+    _k("ED25519_TPU_STRAGGLER_MIN_SAMPLES", "int", 8,
+       "Minimum per-chip latency samples before the straggler rule "
+       "evaluates, AND the consecutive over-ratio streak length that "
+       "accrues one STRAGGLER_SUSPICION event — alternating gray-flap "
+       "windows shorter than this never accrue (no quarantine "
+       "oscillation)."),
+    _k("ED25519_TPU_HEDGE_QUANTILE", "float", 0.95,
+       "Hedge threshold: a dispatched chunk whose elapsed time "
+       "crosses this quantile of recent wave durations (latency "
+       "ledger, per-mille nearest-rank) becomes a hedge candidate — "
+       "its undecided batches re-verify with fresh blinders on the "
+       "host; first valid result wins, the loser is discarded "
+       "unread."),
+    _k("ED25519_TPU_HEDGE_MIN_MS", "float", 50.0,
+       "Floor (milliseconds) under the ledger-derived hedge "
+       "threshold, so cold ledgers and fast meshes don't hedge every "
+       "wave; 0 force-hedges every outstanding chunk (test/lab "
+       "knob)."),
+    _k("ED25519_TPU_HEDGE_BUDGET", "int", 2,
+       "Maximum chunks a single verify_many call may hedge "
+       "concurrently (oldest outstanding — i.e. consensus-first — "
+       "chunks claim the budget first); 0 disables hedged "
+       "re-dispatch."),
+    _k("ED25519_TPU_STRAGGLER_LAB_SEED", "int", 0x57A661,
+       "Default seed for tools/straggler_lab.py's gray-failure "
+       "scenario: the workload, the slow-chip fault plan, and the "
+       "gray-flap windows (the run is a pure function of it)."),
 ])
 
 
@@ -322,6 +358,30 @@ def get_raw(name: str) -> "str | None":
     value semantics (e.g. the jax cache dir opt-out)."""
     KNOBS[name]  # unregistered names must not silently read the env
     return os.environ.get(name)
+
+
+@contextlib.contextmanager
+def override(**knobs):
+    """Scoped environment overrides for registered knobs, restored on
+    exit (even on error).  The labs' sanctioned way to flip live-read
+    knobs — a raw ``os.environ`` write anywhere else trips
+    consensuslint CL003, and for good reason: this is the one place
+    that can insist the name is registered and the previous value comes
+    back."""
+    for name in knobs:
+        KNOBS[name]  # unregistered names must not silently write the env
+    old = {}
+    try:
+        for name, value in knobs.items():
+            old[name] = os.environ.get(name)
+            os.environ[name] = str(value)
+        yield
+    finally:
+        for name, prev in old.items():
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
 
 
 def validate_all() -> "dict[str, Exception]":

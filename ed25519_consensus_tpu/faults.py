@@ -60,6 +60,7 @@ __all__ = [
     "ReplicaCrashError", "ReplicaWedgeError",
     "LaneDeathSignal",
     "Fault", "ErrorOn", "TypedErrorOn", "StallFor", "FlappingLink",
+    "SlowChip", "GrayFlap",
     "CorruptSum", "CorruptChipSum",
     "KillLane", "CorruptResidentEntry", "EvictStorm", "StaleEpochOn",
     "RotateTenant", "ChipLoss", "LinkFlap",
@@ -67,7 +68,8 @@ __all__ = [
     "CorruptStoredVerdict",
     "TornWrite", "BitRot", "TruncateJournal", "VersionSkew",
     "StaleEpochPins",
-    "FaultPlan", "randomized_plan", "storm_plan", "devcache_plan",
+    "FaultPlan", "randomized_plan", "storm_plan", "slow_plan",
+    "devcache_plan",
     "mesh_plan", "sentinel_plan", "typed_error_plan", "replica_plan",
     "verdictcache_plan", "persist_plan",
     "install", "uninstall", "injected", "active_plan",
@@ -306,6 +308,71 @@ class FlappingLink(Fault):
     def before(self, ctx):
         raise InjectedFault(
             f"flapping link down (site={ctx.site}, call={ctx.index})")
+
+
+class SlowChip(Fault):
+    """A GRAY failure (round 18): one chip runs every dispatch it
+    participates in `seconds` slower — no error, no corruption, no
+    signal the breaker or the typed classifier can see.  The delay
+    lands only when `chip` is in the call's placement (the lane and
+    sharded seams pass device_ids as ctx.payload; None = canonical
+    prefix), so a reformed-out or quarantined chip stops slowing
+    anything — exactly the recovery the straggler lab gates.  Virtual
+    clocks advance (the StallFor discipline: deterministic, instant);
+    real clocks sleep.  Detection is the latency ledger's job — this
+    fault deliberately produces CORRECT results, late."""
+
+    def __init__(self, chip: int, seconds: float, on=None,
+                 site: str = SITE_LANE):
+        # Default: every call (a persistent straggler), unlike most
+        # faults' single-shot default — gray failure is a condition,
+        # not an event.
+        super().__init__(on=(lambda i: True) if on is None else on,
+                         site=site)
+        self.chip = int(chip)
+        self.seconds = float(seconds)
+
+    def kind(self) -> str:
+        return f"SlowChip[{self.chip}]"
+
+    def _in_placement(self, ctx) -> bool:
+        ids = (tuple(ctx.payload) if ctx.payload
+               else tuple(range(ctx.mesh or 1)))
+        return self.chip in ids
+
+    def before(self, ctx):
+        if not self._in_placement(ctx):
+            return
+        clock = ctx.clock
+        if clock is not None and getattr(clock, "virtual", False):
+            clock.advance(self.seconds)
+        else:
+            time.sleep(self.seconds)
+
+
+class GrayFlap(SlowChip):
+    """Alternating gray failure: the chip is slow for `period` calls,
+    normal for the next `period`, and so on (first window SLOW — the
+    flap must be observable from call 0; the FlappingLink window
+    idiom, a pure function of the per-site call index, so the plan
+    replays exactly).  This is the no-oscillation regression fixture:
+    windows shorter than ED25519_TPU_STRAGGLER_MIN_SAMPLES must never
+    complete a straggler streak, so the quarantine ladder stays quiet
+    — a mesh that quarantine-flapped on every transient slow spell
+    would thrash devcache residency and reformation for no verdict
+    benefit."""
+
+    def __init__(self, chip: int, seconds: float, period: int = 4,
+                 site: str = SITE_LANE):
+        if period < 1:
+            raise ValueError("period must be >= 1")
+        super().__init__(
+            chip, seconds,
+            on=lambda i, p=period: (i // p) % 2 == 0, site=site)
+        self.period = int(period)
+
+    def kind(self) -> str:
+        return f"GrayFlap[{self.chip}]"
 
 
 class CorruptSum(Fault):
@@ -917,11 +984,15 @@ class FaultPlan:
 def randomized_plan(seed: int, error_rate: float = 0.1,
                     stall_rate: float = 0.05, stall_seconds: float = 0.05,
                     corrupt_rate: float = 0.05, flap_period: int = 0,
+                    slow_rate: float = 0.0, slow_seconds: float = 0.25,
+                    slow_chip: int = 0,
                     site: str = SITE_LANE) -> FaultPlan:
     """A chaos-soak plan: per call index, draw independently (from the
     seed — deterministic and replayable) whether to error, stall, or
     corrupt.  Rates are per-call probabilities; `flap_period` > 0 adds a
-    flapping link on top."""
+    flapping link on top; `slow_rate` > 0 adds gray-failure draws
+    (round 18) — `slow_chip` runs the drawn calls `slow_seconds` late
+    but CORRECT, so the mixed storm also covers slow-is-the-new-down."""
 
     def drawn(kind, rate):
         def fires(i, kind=kind, rate=rate):
@@ -936,12 +1007,16 @@ def randomized_plan(seed: int, error_rate: float = 0.1,
     ]
     if flap_period:
         faults.append(FlappingLink(period=flap_period, site=site))
+    if slow_rate:
+        faults.append(SlowChip(slow_chip, slow_seconds,
+                               on=drawn("slow", slow_rate), site=site))
     return FaultPlan(faults, seed=seed)
 
 
 def storm_plan(seed: int, kind: str, at: int = 0, length: int = 1,
                seconds: float = 6.0, site: str = SITE_LANE,
-               period: int = 2, advance: float = 3600.0) -> FaultPlan:
+               period: int = 2, advance: float = 3600.0,
+               chip: int = 0) -> FaultPlan:
     """An overload/crash schedule for the service-layer soaks: one
     contiguous WINDOW of faults over the device-call stream — the shape
     of a real incident (a storm hits, persists for a while, passes) as
@@ -961,6 +1036,12 @@ def storm_plan(seed: int, kind: str, at: int = 0, length: int = 1,
       deaths hit the replacement lanes at consecutive calls.
     * ``"flap"`` — a FlappingLink of `period` for the whole stream
       (`at`/`length` ignored — flapping has no window).
+    * ``"slow"`` — a gray window (round 18): chip `chip` runs every
+      call in [at, at+length) it participates in `seconds` late —
+      correct results, no error signal, only latency evidence.  The
+      storm shape of a transient gray spell (a thermal event passes, a
+      flaky link reseats) as opposed to slow_plan's whole-stream
+      straggler.
 
     The plan replays exactly like every other FaultPlan: decisions are
     pure functions of (seed, site, call index)."""
@@ -973,8 +1054,54 @@ def storm_plan(seed: int, kind: str, at: int = 0, length: int = 1,
         faults = [KillLane(on=window, advance=advance)]
     elif kind == "flap":
         faults = [FlappingLink(period=period, site=site)]
+    elif kind == "slow":
+        faults = [SlowChip(chip, seconds, on=window, site=site)]
     else:
         raise ValueError(f"unknown storm kind {kind!r}")
+    return FaultPlan(faults, seed=seed)
+
+
+def slow_plan(seed: int, chip: int, seconds: float,
+              base_seconds: float = 0.0, kind: str = "persistent",
+              period: int = 4,
+              sites: "tuple[str, ...]" = (SITE_LANE,)
+              ) -> FaultPlan:
+    """A GRAY-failure schedule (round 18): chip `chip` is `seconds`
+    slow per dispatch it participates in.  Default seam: SITE_LANE
+    only — every scheduler dispatch (single-device, forced-device,
+    probation probes, AND the mesh collectives) crosses the lane seam
+    exactly once, while a mesh dispatch additionally crosses
+    SITE_SHARDED inside it; slowing both would charge the delay twice
+    per mesh call.  Pass sites=(SITE_SHARDED,) for direct sharded_msm
+    call sites that never cross the lane.
+
+    `base_seconds` > 0 additionally slows EVERY chip by that much at
+    the same seams — the virtual-clock trick that makes relative
+    latency measurable: on a FakeClock real compute time is invisible
+    (the clock only moves when a fault advances it), so the healthy
+    mesh needs a nonzero modelled dispatch cost for "10× slower" to
+    mean anything.  base=10 ms with seconds=90 ms models exactly one
+    chip at 10×.
+
+    `kind`: ``"persistent"`` (SlowChip — a condition, not an event) or
+    ``"flap"`` (GrayFlap with `period` — the no-oscillation fixture).
+    Decisions are pure functions of (site, call index), so the plan
+    replays exactly."""
+    faults = []
+    for site in sites:
+        if base_seconds > 0:
+            # The mesh-wide modelled dispatch cost: an all-chips
+            # SlowChip would double-charge the straggler, so model it
+            # as a plain stall on every call at the seam.
+            faults.append(StallFor(base_seconds, on=lambda i: True,
+                                   site=site))
+        if kind == "persistent":
+            faults.append(SlowChip(chip, seconds, site=site))
+        elif kind == "flap":
+            faults.append(GrayFlap(chip, seconds, period=period,
+                                   site=site))
+        else:
+            raise ValueError(f"unknown slow-plan kind {kind!r}")
     return FaultPlan(faults, seed=seed)
 
 

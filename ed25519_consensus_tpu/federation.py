@@ -153,13 +153,18 @@ class Replica:
     observability state — never verdicts."""
 
     __slots__ = ("rid", "service", "cache", "vcache", "degraded_frac",
-                 "pumps", "crashed")
+                 "pumps", "crashed", "latency")
 
     def __init__(self, rid: int, service, cache, vcache=None):
         self.rid = int(rid)
         self.service = service
         self.cache = cache
         self.vcache = vcache
+        # Round 18: NAMESPACED latency ledger — replica-level wave
+        # durations live in this replica's own ledger exactly like its
+        # caches, so one replica's gray-failure evidence never
+        # contaminates a peer's (and dies with the replica on eject).
+        self.latency = _health.LatencyLedger(namespace=f"r{rid}")
         # None = derive from the service's own effective capacity (the
         # PR 8 watermark shrink); a float is an externally-reported
         # fraction (SplitCapacity fault / operator / fleet monitor).
@@ -611,8 +616,10 @@ class ReplicaSet:
         if state in (_health.REPLICA_EJECTED,
                      _health.REPLICA_PROBATION):
             return 0
+        t_pump = self._clock.monotonic()
         ok, n = self._supervised(
             rep, lambda svc=rep.service: svc.process_once(block=False))
+        rep.latency.record((rid,), self._clock.monotonic() - t_pump)
         rep.pumps += 1
         self._prune_tracked(rid)
         return n if (ok and n) else 0
@@ -798,6 +805,13 @@ class ReplicaSet:
                 "dedup_fanout": self._dedup_by_replica.get(rid, 0),
                 "crashed": rep.crashed,
                 "pumps": rep.pumps,
+                # Round 18: the replica's OWN namespaced pump-latency
+                # evidence (integer-µs quantiles; empty dict until the
+                # first pump lands).
+                "latency": {
+                    "namespace": rep.latency.namespace,
+                    **rep.latency.chip_stats().get(rid, {}),
+                },
             }
         return {
             "replicas": per,

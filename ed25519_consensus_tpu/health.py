@@ -37,6 +37,8 @@ THREAD SEMANTICS (the documented contract):
   injected test instances.
 """
 
+import bisect
+import collections
 import hashlib
 import threading
 import time
@@ -54,6 +56,7 @@ __all__ = [
     "ErrorVerdict", "classify_device_error",
     "STATE_HEALTHY", "STATE_SUSPECTED", "STATE_QUARANTINED",
     "STATE_PROBATION", "SENTINEL_SUSPICION", "AMBIGUOUS_SUSPICION",
+    "STRAGGLER_SUSPICION", "LatencyLedger",
     "ReplicaRegistry",
     "REPLICA_HEALTHY", "REPLICA_SUSPECT", "REPLICA_DRAINING",
     "REPLICA_EJECTED", "REPLICA_PROBATION",
@@ -301,6 +304,246 @@ def notify_chip_drop(chip: int, reason: str) -> None:
 SENTINEL_SUSPICION = 1.5
 AMBIGUOUS_SUSPICION = 0.25
 
+# Round 18.  A sustained relative-latency pattern (p90 over ratio ×
+# mesh median for MIN_SAMPLES consecutive dispatches, then again for a
+# full second streak) is STRONG evidence of gray failure — the chip is
+# measurably, persistently slow relative to its peers, not merely
+# unlucky.  Two accrued events cross the default threshold, mirroring
+# the sentinel weight: slow-is-the-new-down.
+STRAGGLER_SUSPICION = 1.5
+
+
+# Latency-ledger bucket edges, in INTEGER microseconds.  Geometric
+# ladder (~26% steps) built by pure integer arithmetic: 100 µs ..
+# 790 s, with one overflow bucket above.  Durations are bucketed once
+# on entry and every quantile is answered with a bucket representative,
+# so no float ever touches a latency quantity after the single
+# seconds→µs scaling at the recording boundary (consensuslint CL001
+# scopes the ledger symbols).
+_LATENCY_MANTISSAS_US = (10, 13, 16, 20, 25, 32, 40, 50, 63, 79)
+_LATENCY_EDGES_US = tuple(
+    m * 10 ** k for k in range(1, 8) for m in _LATENCY_MANTISSAS_US)
+_LATENCY_OVERFLOW_US = _LATENCY_EDGES_US[-1] * 10
+
+
+class LatencyLedger:
+    """Per-chip streaming dispatch-latency quantiles — the latency half
+    of the health subsystem (round 18).
+
+    Every device dispatch the scheduler completes lands here once:
+    `record(chips, seconds)` attributes the measured wall duration
+    (`call_dt`, measured on the LANE's injected clock — the ledger
+    itself never reads a clock) to every chip of the placement, bucketed
+    into a fixed geometric integer-µs histogram.  Quantiles are
+    deterministic nearest-rank over bucket representatives: the same
+    sample sequence always yields the same integers, on any host.
+
+    The relative-straggler rule: once a chip has
+    ED25519_TPU_STRAGGLER_MIN_SAMPLES samples, each dispatch where its
+    ring p90 exceeds ED25519_TPU_STRAGGLER_RATIO × the mesh-wide median
+    AND the dispatch itself is over the same gate extends a streak; a
+    full streak of MIN_SAMPLES consecutive over-ratio dispatches flags
+    the chip (the caller accrues STRAGGLER_SUSPICION into the round-10
+    ladder) and resets the streak.  The current-dispatch condition is
+    load-bearing: a flapping chip's ring p90 stays elevated through its
+    NORMAL windows (half the ring is slow samples), so p90 alone would
+    quarantine every gray flap — the streak demand is what keeps flap
+    from oscillating quarantine: alternating slow/normal windows
+    shorter than MIN_SAMPLES keep breaking the streak and never
+    accrue, while a persistently slow chip extends it on every
+    dispatch.  The
+    comparison runs in scaled integers (`p90_us * 1000 > ratio_milli *
+    median_us`) — the float knob is collapsed to an integer per-mille
+    once, at read.
+
+    Attribution is placement-relative: a full-mesh dispatch smears its
+    duration over all chips, so p90 == median for everyone and nobody
+    flags — exactness comes from placement DIVERSITY (probes, reformed
+    sub-rungs, forced-device sweeps), the same way round-10 ambiguity
+    smearing resolves.  The ledger also keeps a cross-placement ring of
+    recent wave durations: `wave_quantile_us` is the hedge-threshold
+    input, `gate_us` the probation latency gate (ratio × mesh median; 0
+    = no evidence yet, gate abstains).
+
+    Latency evidence gates PLACEMENT and TIMING, never math: no verdict
+    path reads the ledger (docs/consensus-invariants.md).  Thread
+    contract: every mutable field under `_lock`, no call-outs while
+    holding it; the ledger lock is a LEAF in the lock hierarchy (never
+    taken together with the registry lock or any scheduler lock)."""
+
+    WINDOW = 64        # per-chip ring of bucketed samples
+    WAVE_WINDOW = 128  # cross-placement ring of recent dispatches
+
+    def __init__(self, namespace: str = "chips"):
+        # Namespace tags the ledger's metrics/snapshot surface —
+        # federation runs one ledger per replica ("r0", "r1", ...) so
+        # replica latency evidence never cross-contaminates.
+        self.namespace = str(namespace)
+        self._lock = threading.Lock()
+        self._samples = {}  # chip -> deque[bucket index], maxlen=WINDOW
+        self._streak = {}   # chip -> consecutive over-ratio dispatches
+        self._events = {}   # chip -> completed straggler streaks
+        self._waves = collections.deque(maxlen=self.WAVE_WINDOW)
+
+    # -- knobs (live reads; float knob collapsed to integer per-mille) ----
+
+    @staticmethod
+    def _ratio_milli() -> int:
+        return int(round(_config.get("ED25519_TPU_STRAGGLER_RATIO") * 1000))
+
+    @staticmethod
+    def _min_samples() -> int:
+        return max(1, int(_config.get("ED25519_TPU_STRAGGLER_MIN_SAMPLES")))
+
+    # -- bucket machinery (pure integer) ----------------------------------
+
+    @staticmethod
+    def _bucket_of(us: int) -> int:
+        return bisect.bisect_left(_LATENCY_EDGES_US, us)
+
+    @staticmethod
+    def _rep_us(idx: int) -> int:
+        if idx >= len(_LATENCY_EDGES_US):
+            return _LATENCY_OVERFLOW_US
+        return _LATENCY_EDGES_US[idx]
+
+    @staticmethod
+    def _quantile_us(sorted_idxs, q_milli: int) -> int:
+        """Nearest-rank quantile (q in per-mille) over sorted bucket
+        indices, answered as the bucket-representative integer µs."""
+        n = len(sorted_idxs)
+        if n == 0:
+            return 0
+        k = (int(q_milli) * (n - 1)) // 1000
+        return LatencyLedger._rep_us(sorted_idxs[k])
+
+    # -- write side -------------------------------------------------------
+
+    def record(self, chips, seconds) -> "tuple[int, ...]":
+        """Land one completed dispatch: `seconds` measured on the
+        scheduler's injected clock, attributed to every chip in
+        `chips` (the placement).  Returns the chips that completed a
+        full over-ratio streak on this record — the caller accrues
+        STRAGGLER_SUSPICION for each (the ledger itself never touches
+        the suspicion ladder: leaf lock, no call-outs)."""
+        us = int(seconds * 1000000)
+        if us < 0:
+            us = 0
+        idx = self._bucket_of(us)
+        cur_us = self._rep_us(idx)
+        ratio_milli = self._ratio_milli()
+        need = self._min_samples()
+        flagged = []
+        with self._lock:
+            self._waves.append(idx)
+            rings = []
+            for c in chips:
+                c = int(c)
+                ring = self._samples.get(c)
+                if ring is None:
+                    ring = self._samples[c] = collections.deque(
+                        maxlen=self.WINDOW)
+                ring.append(idx)
+                rings.append((c, ring))
+            pool = sorted(i for r in self._samples.values() for i in r)
+            med_us = self._quantile_us(pool, 500)
+            for c, ring in rings:
+                if len(ring) < need:
+                    continue
+                p90_us = self._quantile_us(sorted(ring), 900)
+                if (p90_us * 1000 > ratio_milli * med_us
+                        and cur_us * 1000 > ratio_milli * med_us):
+                    streak = self._streak.get(c, 0) + 1
+                    if streak >= need:
+                        flagged.append(c)
+                        self._events[c] = self._events.get(c, 0) + 1
+                        streak = 0
+                    self._streak[c] = streak
+                else:
+                    self._streak[c] = 0
+        return tuple(flagged)
+
+    # -- read side --------------------------------------------------------
+
+    def chip_p90_us(self, chip: int) -> int:
+        with self._lock:
+            ring = self._samples.get(int(chip))
+            if not ring:
+                return 0
+            return self._quantile_us(sorted(ring), 900)
+
+    def mesh_median_us(self) -> int:
+        with self._lock:
+            pool = sorted(i for r in self._samples.values() for i in r)
+            return self._quantile_us(pool, 500)
+
+    def wave_quantile_us(self, q_milli: int) -> int:
+        """Quantile (per-mille) of recent cross-placement dispatch
+        durations — the hedge-threshold input.  0 = no dispatches
+        recorded yet (callers fall back to their floor)."""
+        with self._lock:
+            if not self._waves:
+                return 0
+            return self._quantile_us(sorted(self._waves), q_milli)
+
+    def wave_samples(self) -> int:
+        """How many recent dispatches the wave ring holds — the hedge
+        ARMING input: a tail quantile over a cold ring is noise, not
+        evidence, so the scheduler keeps hedging disarmed until the
+        ring is warm (batch.verify_many's _hedge_threshold_s)."""
+        with self._lock:
+            return len(self._waves)
+
+    def gate_us(self) -> int:
+        """Probation latency gate: ratio × mesh median, integer µs via
+        the scaled-integer multiply.  0 = no latency evidence yet; the
+        gate ABSTAINS (correctness-only probation, the round-10
+        behavior)."""
+        med_us = self.mesh_median_us()
+        if med_us <= 0:
+            return 0
+        return (self._ratio_milli() * med_us) // 1000
+
+    def within_gate(self, seconds) -> bool:
+        """Does one measured probe duration pass the latency gate?"""
+        gate = self.gate_us()
+        if gate <= 0:
+            return True
+        us = int(seconds * 1000000)
+        if us < 0:
+            us = 0
+        return us <= gate
+
+    def chip_stats(self) -> "dict[int, dict]":
+        """Observability snapshot, all integers: per chip {samples,
+        p50_us, p90_us, streak, straggler_events}."""
+        with self._lock:
+            out = {}
+            for c in sorted(self._samples):
+                s = sorted(self._samples[c])
+                out[c] = {
+                    "samples": len(s),
+                    "p50_us": self._quantile_us(s, 500),
+                    "p90_us": self._quantile_us(s, 900),
+                    "streak": self._streak.get(c, 0),
+                    "straggler_events": self._events.get(c, 0),
+                }
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._streak.clear()
+            self._events.clear()
+            self._waves.clear()
+
+    def __repr__(self):
+        with self._lock:
+            return ("LatencyLedger(namespace=%r, chips=%r, waves=%d)"
+                    % (self.namespace, sorted(self._samples),
+                       len(self._waves)))
+
+
 STATE_HEALTHY = "healthy"
 STATE_SUSPECTED = "suspected"
 STATE_QUARANTINED = "quarantined"
@@ -378,6 +621,12 @@ class ChipRegistry:
         self._suspicion = {}
         self._state = {}
         self._probation_passes = {}
+        # Round 18 — the latency half: per-chip dispatch-duration
+        # quantiles feeding the relative-straggler rule.  The ledger
+        # owns its own LEAF lock; the registry lock and the ledger lock
+        # are never held together (record_latency talks to the ledger
+        # first, then the suspicion ladder, sequentially).
+        self.latency = LatencyLedger()
 
     # -- knobs (live reads through the config registry) -------------------
 
@@ -500,6 +749,23 @@ class ChipRegistry:
             # the SAME listener path as a chip loss.
             notify_chip_drop(chip, f"chip-quarantine: {reason}")
         return state
+
+    def record_latency(self, chips, seconds) -> "tuple[int, ...]":
+        """Round 18: feed one completed dispatch duration (seconds on
+        the scheduler's injected clock) to the latency ledger,
+        attributed to every chip of `chips` (the placement), and accrue
+        STRAGGLER_SUSPICION for any chip that completed a full
+        over-ratio streak — latency evidence enters the SAME
+        suspicion → quarantine → probation → rejoin ladder as sentinel
+        divergence.  Returns the flagged chips.  The ledger lock and
+        the registry lock are never held together: the ledger records
+        first (leaf lock), then each flagged chip goes through
+        `record_suspicion` sequentially."""
+        flagged = self.latency.record(chips, seconds)
+        for c in flagged:
+            self.record_suspicion(c, STRAGGLER_SUSPICION,
+                                  "straggler: p90 over ratio x mesh median")
+        return flagged
 
     def chip_state(self, chip: int) -> str:
         """The chip's suspicion-ladder state (healthy / suspected /
@@ -629,6 +895,8 @@ class ChipRegistry:
             self._state.clear()
             self._probation_passes.clear()
             self.clock = SYSTEM_CLOCK
+        # Outside the registry lock (leaf-lock discipline).
+        self.latency.reset()
 
     def __repr__(self):
         with self._lock:

@@ -308,6 +308,18 @@ class RoutingPolicy:
         d, _ids = reform_for(d_cfg)
         if d < self.min_devices:
             return 0
+        # Round 18, REPORT-ONLY: surface the latency ledger's measured
+        # wave overhead next to the N* estimate's modelled fixed cost,
+        # so the hardware-capture session (ROADMAP 1(b)) can replace
+        # the constant with the measurement.  The gauge is written on
+        # the routing read; the DECISION below still uses the modelled
+        # fixed_cost_s unchanged this round.
+        _measured_us = _health.chip_registry().latency.mesh_median_us()
+        if _measured_us:
+            from .utils import metrics as _metrics
+
+            _metrics.set_gauge("routing_measured_wave_overhead_us",
+                               _measured_us)
         if est_terms_per_batch <= self.crossover_terms(
                 d, devcache_hot=devcache_hot, tables_hot=tables_hot):
             return 0

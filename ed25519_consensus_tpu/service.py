@@ -429,6 +429,11 @@ class VerifyService:
             # occupancy, no device work), and ladder-decided verdicts
             # written to the memo store after their wave.
             "verdict_cache_hits": 0, "verdict_cache_stores": 0,
+            # Gray-failure defense (round 18): hedge pairs fired/won/
+            # lost across all device waves, and straggler-streak
+            # suspicion accruals the latency ledger attributed.
+            "hedges_fired": 0, "hedges_won": 0, "hedges_lost": 0,
+            "straggler_suspicion_events": 0,
         }
         # Per-class lifecycle tallies (the fairness surface the traffic
         # lab and the SLO gates read): every submission lands in
@@ -873,11 +878,20 @@ class VerifyService:
                 # a half-open breaker needs evidence, and a host-raced
                 # probe that never measures the device would stay
                 # half-open forever.
+                # The wave's tightest request deadline rides along for
+                # hedge affordability (round 18).  _take_wave drains
+                # classes in priority order, so consensus requests sit
+                # EARLIEST in the wave — the oldest-chunk-first hedge
+                # budget therefore serves consensus first by
+                # construction.
+                _dls = [r.deadline for r in reqs
+                        if r.deadline is not None]
                 verdicts = _batch.verify_many(
                     vs, rng=self._rng, chunk=self.chunk,
                     hybrid=False if probe else self.hybrid,
                     merge=self.merge, mesh=mesh_arg,
-                    health=self.health, policy=self.policy)
+                    health=self.health, policy=self.policy,
+                    deadline=min(_dls) if _dls else None)
                 stats = dict(_batch.last_run_stats)
                 self._note_device_outcome(stats, probe)
             else:
@@ -916,6 +930,22 @@ class VerifyService:
             self.totals["devcache_hot_waves"] += 1
         self.totals["devcache_dispatch_hits"] += dc.get(
             "dispatch_hits", 0)
+        # Gray-failure roll-up (round 18): hedge pair outcomes and
+        # straggler attributions per wave, plus the latency-ledger
+        # gauges operators chart next to the SLO percentiles.
+        for k in ("hedges_fired", "hedges_won", "hedges_lost",
+                  "straggler_suspicion_events"):
+            self.totals[k] += stats.get(k, 0)
+        led = _health.chip_registry().latency
+        _metrics.set_gauges({
+            "latency_mesh_median_us": led.mesh_median_us(),
+            "latency_wave_p95_us": led.wave_quantile_us(950),
+            "hedges_fired": self.totals["hedges_fired"],
+            "hedges_won": self.totals["hedges_won"],
+            "hedges_lost": self.totals["hedges_lost"],
+            "straggler_suspicion_events":
+                self.totals["straggler_suspicion_events"],
+        })
         failed = bool(stats.get("device_sick")) \
             or stats.get("device_errors", 0) > 0
         participated = (

@@ -461,3 +461,194 @@ def test_lane_death_signal_is_not_an_error_result():
     assert not lane._thread.is_alive()
     assert not lane.healthy()
     assert lane.wait(cid, 0.0) is batch._PENDING  # no result was reported
+
+
+# -- fault class: gray failure (round 18) ---------------------------------
+
+
+def test_slowchip_advances_only_in_placement():
+    """SlowChip is placement-scoped: the delay lands exactly when the
+    chip is in the call's device_ids payload (None = canonical mesh
+    prefix) — a reformed-out chip stops slowing anything."""
+    clk = health.FakeClock()
+    plan = faults.FaultPlan([faults.SlowChip(3, 2.0)])
+    with faults.injected(plan):
+        t0 = clk.monotonic()
+        faults.run_device_call(faults.SITE_LANE, lambda: "ok",
+                               clock=clk, payload=(3, 7))
+        assert clk.monotonic() - t0 == 2.0
+        t0 = clk.monotonic()
+        faults.run_device_call(faults.SITE_LANE, lambda: "ok",
+                               clock=clk, payload=(0, 1))
+        assert clk.monotonic() - t0 == 0.0
+        t0 = clk.monotonic()  # canonical prefix of a mesh-8 call
+        faults.run_device_call(faults.SITE_LANE, lambda: "ok",
+                               mesh=8, clock=clk, payload=None)
+        assert clk.monotonic() - t0 == 2.0
+
+
+def test_grayflap_first_window_slow_then_alternates():
+    """GrayFlap's window is a pure function of the per-site call index
+    (period slow, period normal, first window SLOW) — the replayable
+    no-oscillation fixture the straggler lab drives."""
+    clk = health.FakeClock()
+    plan = faults.FaultPlan([faults.GrayFlap(0, 1.0, period=2)])
+    advances = []
+    with faults.injected(plan):
+        for _ in range(8):
+            t0 = clk.monotonic()
+            faults.run_device_call(faults.SITE_LANE, lambda: "ok",
+                                   clock=clk, payload=(0,))
+            advances.append(clk.monotonic() - t0)
+    assert advances == [1.0, 1.0, 0.0, 0.0, 1.0, 1.0, 0.0, 0.0]
+
+
+def test_slow_plan_composition_and_validation():
+    """slow_plan models base dispatch cost on EVERY call plus the gray
+    chip's excess — lane seam only, so a mesh dispatch is never
+    double-charged — and rejects unknown kinds."""
+    clk = health.FakeClock()
+    plan = faults.slow_plan(9, 5, 0.09, base_seconds=0.01)
+    with faults.injected(plan):
+        t0 = clk.monotonic()
+        faults.run_device_call(faults.SITE_LANE, lambda: "ok",
+                               clock=clk, payload=(5,))
+        assert round(clk.monotonic() - t0, 6) == 0.10
+        t0 = clk.monotonic()
+        faults.run_device_call(faults.SITE_LANE, lambda: "ok",
+                               clock=clk, payload=(2,))
+        assert round(clk.monotonic() - t0, 6) == 0.01
+        # the sharded seam inside a mesh call stays untouched
+        t0 = clk.monotonic()
+        faults.run_device_call(faults.SITE_SHARDED, lambda: "ok",
+                               clock=clk, payload=(5,))
+        assert clk.monotonic() - t0 == 0.0
+    with pytest.raises(ValueError):
+        faults.slow_plan(9, 5, 0.09, kind="sometimes")
+
+
+def _matrix_verifier():
+    """The FULL 196-case ZIP215 conformance matrix (every (A, R) pair
+    over the 8 torsion + 6 non-canonical encodings, s = 0 — all valid
+    under ZIP215), one batch (the tests/test_devcache.py construction
+    at stride 1)."""
+    from ed25519_consensus_tpu import Signature
+    from ed25519_consensus_tpu.ops import edwards
+    from ed25519_consensus_tpu.utils import fixtures
+
+    encs = [p.compress() for p in edwards.eight_torsion()]
+    encs += fixtures.non_canonical_point_encodings()[:6]
+    v = batch.Verifier()
+    for A_bytes in encs:
+        for R_bytes in encs:
+            v.queue((A_bytes, Signature(R_bytes, b"\x00" * 32), b"Zcash"))
+    assert len(encs) ** 2 == 196
+    return v
+
+
+def _run_force_hedged(vs, monkeypatch, mesh=0, plan=None):
+    """Force-hedge (HEDGE_MIN_MS=0) with the device leg wedged behind
+    DEVICE_CALL_LOCK (held reentrantly by this thread), so the host
+    twin deterministically overtakes every chunk; the loser's late call
+    lands at the fault seam after release (hold the plan installed
+    until it has — with ErrorOn it errors instantly, with CorruptSum
+    the result arrives corrupted; either way the chunk is already
+    discarded and the result is dropped UNREAD)."""
+    import time as _time
+
+    monkeypatch.setenv("ED25519_TPU_HEDGE_MIN_MS", "0")
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=mesh, clock=clock)
+    health.chip_registry().set_clock(clock)
+    if plan is None:
+        plan = faults.FaultPlan([faults.ErrorOn(on=every_call)], seed=3)
+    with faults.injected(plan):
+        with msm.DEVICE_CALL_LOCK:
+            got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                    merge="never", mesh=mesh, health=hp)
+        # When the worker consumed the discard pre-call (it empties
+        # lane._discarded and skips the dispatch), no late call is
+        # coming — don't wait out the timeout for nothing.
+        lane = batch._DeviceLane._instances.get(mesh)
+        t_end = _time.monotonic() + 10.0
+        while (plan.calls_seen(faults.SITE_LANE) == 0
+               and lane is not None and lane._discarded
+               and _time.monotonic() < t_end):
+            _time.sleep(0.002)
+    return got, dict(batch.last_run_stats)
+
+
+def _device_decided(stats):
+    return (stats.get("device_batches", 0)
+            + stats.get("device_rejects_confirmed", 0)
+            + stats.get("device_rejects_overturned", 0))
+
+
+def test_small_order_matrix_via_hedge_path_single_device(monkeypatch):
+    """Satellite (c): the full 196-case small-order × non-canonical
+    matrix decided entirely by the hedge twin — bit-identical to the
+    pure-host path (all True under ZIP215), zero device-decided
+    batches."""
+    vs = [_matrix_verifier()]
+    hv = host_verdicts([_matrix_verifier()])
+    got, stats = _run_force_hedged(vs, monkeypatch, mesh=0)
+    assert got == hv == [True]
+    assert stats["hedges_fired"] == 1 and stats["hedges_won"] == 1
+    assert _device_decided(stats) == 0
+
+
+def test_small_order_matrix_via_hedge_path_virtual_mesh(monkeypatch):
+    """Same matrix through the hedge path on the virtual 8-chip mesh —
+    the sharded device leg is the loser this time; verdicts identical."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("need 8 devices")
+    vs = [_matrix_verifier()]
+    hv = host_verdicts([_matrix_verifier()])
+    got, stats = _run_force_hedged(vs, monkeypatch, mesh=8)
+    assert got == hv == [True]
+    assert stats["hedges_fired"] == 1 and stats["hedges_won"] == 1
+    assert _device_decided(stats) == 0
+
+
+def test_hedge_loser_result_is_discarded_unread(monkeypatch):
+    """First-valid-wins, loser side: the device leg RUNS and returns a
+    corrupted sum after the twin already won — the result must be
+    dropped at the lane seam unread: verdicts stay host-identical and
+    no device reject/accept is ever published from it."""
+    warm_kernel_for_chunk()
+    vs = make_verifiers(2, bad={1})
+    hv = host_verdicts(make_verifiers(2, bad={1}))
+    plan = faults.FaultPlan(
+        [faults.CorruptSum(on=every_call)], seed=4)
+    got, stats = _run_force_hedged(vs, monkeypatch, plan=plan)
+    assert got == hv == [True, False]
+    assert stats["hedges_fired"] >= 1
+    assert (stats["hedges_won"] + stats["hedges_lost"]
+            == stats["hedges_fired"])
+    assert _device_decided(stats) == 0
+
+
+@pytest.mark.slow
+def test_hedge_twin_restages_fresh_blinders(monkeypatch):
+    """The hedge twin is a fresh host RE-verification: every batch it
+    decides routes through _host_verdict (which restages with new RLC
+    blinders from the call rng) — a pair's legs never share staged
+    state, so a poisoned device staging cannot leak into the twin.
+    Slow-marked (~10 s, real device leg): tier-1 keeps the cheap
+    fresh-blinder twin pin in tests/test_straggler.py; the faults CI
+    job runs this file unfiltered."""
+    staged = []
+    real = batch._host_verdict
+
+    def spy(v, r):
+        staged.append(v)
+        return real(v, r)
+
+    monkeypatch.setattr(batch, "_host_verdict", spy)
+    vs = make_verifiers(2)
+    got, stats = _run_force_hedged(vs, monkeypatch)
+    assert got == [True, True]
+    assert stats["hedges_won"] == 1
+    assert set(map(id, staged)) == set(map(id, vs))

@@ -521,3 +521,34 @@ def test_racing_submission_onto_ejected_replica_is_swept():
         assert fed.replica_id != home
         assert fs.totals["ejections"] == ejections_before  # no double
     fs.close()
+
+
+def test_per_replica_latency_ledger_is_namespaced():
+    """Round 18: each replica owns a NAMESPACED latency ledger (like
+    its caches) — pump-wave durations land only in that replica's
+    ledger, so one replica's gray-failure evidence never contaminates
+    a peer's, and the stats surface carries the integer-µs quantiles
+    per replica."""
+    fs, clock = make_set()
+    try:
+        assert [fs.replicas[r].latency.namespace for r in (0, 1, 2)] \
+            == ["r0", "r1", "r2"]
+        f = fs.submit(make_verifier("chain-a", 0), tenant="chain-a")
+        drain(fs)
+        assert f.result(5) is True
+        st = fs.stats()
+        rows = st["replicas"]
+        for rid, row in rows.items():
+            assert row["latency"]["namespace"] == f"r{rid}"
+        # every pumped replica recorded ITS OWN waves — all integers —
+        # and nobody recorded anybody else's
+        pumped = [rid for rid, row in rows.items()
+                  if row["latency"].get("samples")]
+        assert pumped
+        for rid in pumped:
+            led = fs.replicas[rid].latency
+            assert set(led.chip_stats()) == {rid}
+            assert all(isinstance(x, int)
+                       for x in led.chip_stats()[rid].values())
+    finally:
+        fs.close()

@@ -773,3 +773,78 @@ def test_reset_all_abandons_worker_that_outlives_deadline(monkeypatch):
     assert batch._DeviceLane.reset_all(timeout=10.0)
     assert lane not in batch._DeviceLane._abandoned_instances
     assert not lane._thread.is_alive()
+
+
+# -- round 18: hedge path vs transient-retry budget -----------------------
+
+
+def test_hedged_chunk_transient_error_burns_no_retry_budget(monkeypatch):
+    """Satellite (a) regression: a transient device error on a chunk
+    that ALREADY carries a hedge twin must not burn the transient-retry
+    budget — the twin covers those batches, so the undecided tail is
+    decided host-side immediately (hedge_device_error, not
+    device_transient_retry).  The device leg is gated on the twin
+    having fired, so the interleaving is deterministic."""
+    from ed25519_consensus_tpu.utils import metrics
+
+    monkeypatch.setenv("ED25519_TPU_HEDGE_MIN_MS", "0")  # force-hedge
+    hp = fake_health()
+    lane = batch._DeviceLane.get(mesh=0, health=hp)
+    twin_started = threading.Event()
+
+    def stalling_transient(digits, pts):
+        # the worker leg: hold until the hedge twin is live, then fail
+        # transiently — the error lands while the chunk is hedged
+        twin_started.wait(10.0)
+        raise TimeoutError("injected transient on a hedged chunk")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many",
+                        stalling_transient)
+    real = batch._host_verdict
+    first = []
+
+    def spy(v, r):
+        out = real(v, r)
+        if not first:
+            first.append(1)
+            twin_started.set()
+            # wait (real time, bounded) until the worker delivered the
+            # transient error for the still-outstanding hedged chunk
+            t_end = time.monotonic() + 10.0
+            while not lane._results and time.monotonic() < t_end:
+                time.sleep(0.002)
+        return out
+
+    monkeypatch.setattr(batch, "_host_verdict", spy)
+    base = metrics.fault_counters().get("hedge_device_error", 0)
+    vs = make_verifiers(2, bad={1})
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never", health=hp)
+    assert verdicts == expected(2, bad={1})
+    stats = batch.last_run_stats
+    assert stats["hedges_fired"] == 1 and stats["hedges_won"] == 1
+    assert stats["device_errors"] == 1
+    assert stats["transient_retries"] == 0  # the separation under test
+    assert stats["host_batches"] == 2
+    assert metrics.fault_counters()["hedge_device_error"] == base + 1
+
+
+def test_unhedged_transient_error_still_retries(monkeypatch):
+    """The counterpart: with hedging disarmed (cold wave ring, default
+    floor) a transient error on an ordinary chunk walks the bounded
+    retry path exactly as before round 18."""
+    calls = []
+
+    def flaky(digits, pts):
+        calls.append(1)
+        raise TimeoutError("injected transient")
+
+    monkeypatch.setattr(msm, "dispatch_window_sums_many", flaky)
+    vs = make_verifiers(2)
+    verdicts = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                 merge="never", health=fake_health())
+    assert verdicts == expected(2)
+    stats = batch.last_run_stats
+    assert stats["hedges_fired"] == 0
+    assert stats["transient_retries"] >= 1
+    assert len(calls) == 1 + stats["transient_retries"]

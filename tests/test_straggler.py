@@ -1,0 +1,343 @@
+"""Gray-failure defense (round 18): the latency ledger and the
+straggler→hedge machinery built on it.
+
+The consensus rule under test: LATENCY EVIDENCE GATES PLACEMENT AND
+TIMING, NEVER MATH.  The ledger is pure integers on an injected clock
+(no float ever touches a latency quantity after the one seconds→µs
+scaling at the recording boundary), straggler streaks feed the
+round-10 suspicion ladder exactly like sentinel divergence, probation
+probes must now clear a latency gate on top of the correctness gate,
+and a hedge twin re-verifies with fresh blinders — first valid result
+wins, the loser is discarded unread.  tools/straggler_lab.py drives
+the same machinery end to end under CI; these are the unit and
+scheduler-seam pins."""
+
+import random
+import time
+
+import pytest
+
+from ed25519_consensus_tpu import SigningKey, batch, config, faults, health
+from ed25519_consensus_tpu.ops import msm
+
+rng = random.Random(0x57A6)
+
+BASE = 0.010   # modelled healthy dispatch (10 ms = bucket rep 10000 µs)
+SLOW = 0.100   # modelled gray dispatch (10x = bucket rep 100000 µs)
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    faults.uninstall()
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+    yield
+    faults.uninstall()
+    # Lane workers stay alive across tests (the PR 5 session-reuse
+    # idiom from test_scheduler.py): a per-test reset_all() pays a
+    # multi-second join per teardown when a sibling file's worker is
+    # parked mid-compile — and on timeout ABANDONS it, forcing the
+    # next device test to recompile.  Only a worker this file actually
+    # wedged (lane marked stuck) must be joined, because it could hold
+    # the device call lock into the next test.
+    if health.any_lane_stuck():
+        batch._DeviceLane.reset_all()
+    batch.reset_device_health()
+    batch.last_run_stats.clear()
+
+
+def make_verifiers(n_batches, sigs_per_batch=3, bad=()):
+    out = []
+    for b in range(n_batches):
+        v = batch.Verifier()
+        for i in range(sigs_per_batch):
+            sk = SigningKey.new(rng)
+            msg = b"straggler-%d-%d" % (b, i)
+            sig = sk.sign(msg if (b not in bad or i != 0) else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        out.append(v)
+    return out
+
+
+def feed_healthy(led, chips=range(8), rounds=4, seconds=BASE):
+    """Give every chip `rounds` single-chip samples at the healthy
+    cost — the placement-diverse pool the relative rule compares
+    against."""
+    for _ in range(rounds):
+        for c in chips:
+            led.record((c,), seconds)
+
+
+# -- ledger unit semantics -------------------------------------------------
+
+def test_bucket_edges_are_integer_and_monotone():
+    edges = health._LATENCY_EDGES_US
+    assert all(isinstance(e, int) for e in edges)
+    assert list(edges) == sorted(set(edges))
+    assert edges[0] == 100 and edges[-1] < health._LATENCY_OVERFLOW_US
+    led = health.LatencyLedger()
+    # representatives are integers for every bucket incl. overflow
+    assert led._rep_us(0) == 100
+    assert led._rep_us(len(edges)) == health._LATENCY_OVERFLOW_US
+
+
+def test_quantiles_are_deterministic_integer_bucket_reps():
+    # 8 healthy + 2 slow: nearest-rank p50 (k=4) is the healthy
+    # bucket, p90 (k=8) lands on the first slow sample
+    samples = (BASE,) * 8 + (SLOW,) * 2
+    led = health.LatencyLedger()
+    for s in samples:
+        led.record((0,), s)
+    st = led.chip_stats()[0]
+    assert st["p50_us"] == 10000 and st["p90_us"] == 100000
+    assert isinstance(st["p50_us"], int) and isinstance(st["p90_us"], int)
+    assert led.mesh_median_us() == 10000
+    assert led.wave_quantile_us(950) == 100000
+    # same samples, same integers — a second ledger agrees exactly
+    led2 = health.LatencyLedger()
+    for s in samples:
+        led2.record((0,), s)
+    assert led2.chip_stats() == led.chip_stats()
+
+
+def test_persistent_straggler_completes_streaks(monkeypatch):
+    monkeypatch.setenv("ED25519_TPU_STRAGGLER_MIN_SAMPLES", "4")
+    led = health.LatencyLedger()
+    feed_healthy(led)
+    flagged = []
+    for _ in range(10):
+        flagged += led.record((7,), SLOW)
+        # peers keep the pool median honest (chip 7 stays slow-only)
+        feed_healthy(led, chips=range(7), rounds=1)
+    # the ring p90 crosses on the 2nd slow sample, so the first full
+    # MIN_SAMPLES streak completes on slow dispatch 5, the next on 9 —
+    # flagged exactly on the slow chip, nobody else
+    assert flagged == [7, 7]
+    assert led.chip_stats()[7]["straggler_events"] == 2
+    assert all(st["straggler_events"] == 0
+               for c, st in led.chip_stats().items() if c != 7)
+
+
+def test_full_placement_smearing_never_flags(monkeypatch):
+    """A full-mesh dispatch attributes its duration to every chip:
+    p90 == median for everyone, so nobody can be singled out — the
+    exactness of attribution comes from placement DIVERSITY, and
+    smeared evidence must stay inert (round-10 ambiguity discipline)."""
+    monkeypatch.setenv("ED25519_TPU_STRAGGLER_MIN_SAMPLES", "4")
+    led = health.LatencyLedger()
+    for _ in range(32):
+        assert led.record(range(8), SLOW) == ()
+    assert all(st["straggler_events"] == 0
+               for st in led.chip_stats().values())
+
+
+def test_flap_windows_shorter_than_min_samples_never_flag(monkeypatch):
+    """The no-oscillation rule: a chip alternating slow/normal windows
+    shorter than MIN_SAMPLES keeps breaking the streak — even though
+    its ring p90 stays over the gate (half the ring is slow samples),
+    the current-dispatch condition resets the count."""
+    monkeypatch.setenv("ED25519_TPU_STRAGGLER_MIN_SAMPLES", "4")
+    led = health.LatencyLedger()
+    feed_healthy(led)
+    for w in range(12):
+        s = SLOW if w % 2 == 0 else BASE  # windows of 2 < MIN_SAMPLES
+        for _ in range(2):
+            assert led.record((7,), s) == ()
+        feed_healthy(led, rounds=1)
+    st = led.chip_stats()[7]
+    assert st["straggler_events"] == 0
+    # the ring p90 IS elevated — the guard is the per-dispatch check
+    assert led.chip_p90_us(7) * 1000 > 3000 * led.mesh_median_us()
+
+
+def test_gate_abstains_without_evidence_then_scales_median():
+    led = health.LatencyLedger()
+    assert led.gate_us() == 0
+    assert led.within_gate(3600.0)  # no evidence: correctness-only
+    feed_healthy(led)
+    assert led.gate_us() == 3 * 10000  # default ratio 3.0, integers
+    assert led.within_gate(0.030) and not led.within_gate(0.031)
+
+
+def test_ledger_namespaces_are_isolated():
+    a, b = health.LatencyLedger("r0"), health.LatencyLedger("r1")
+    a.record((0,), BASE)
+    assert a.namespace == "r0" and b.namespace == "r1"
+    assert a.chip_stats() and not b.chip_stats()
+    assert "r0" in repr(a)
+
+
+def test_reset_clears_all_latency_state():
+    led = health.LatencyLedger()
+    feed_healthy(led)
+    led.reset()
+    assert led.chip_stats() == {} and led.wave_quantile_us(950) == 0
+
+
+# -- ladder wiring ---------------------------------------------------------
+
+def test_record_latency_walks_the_quarantine_ladder(monkeypatch):
+    """Straggler streaks accrue STRAGGLER_SUSPICION into the SAME
+    suspicion→quarantine ladder as sentinel divergence: two completed
+    streaks cross the default threshold on a frozen clock."""
+    monkeypatch.setenv("ED25519_TPU_STRAGGLER_MIN_SAMPLES", "2")
+    clock = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    feed_healthy(reg.latency, rounds=2)
+    flags = 0
+    for _ in range(6):
+        flags += len(reg.record_latency((3,), SLOW))
+        feed_healthy(reg.latency,
+                     chips=[c for c in range(8) if c != 3], rounds=1)
+        if reg.chip_state(3) == health.STATE_QUARANTINED:
+            break
+    assert flags >= 2
+    assert reg.chip_state(3) == health.STATE_QUARANTINED
+    assert 3 in reg.excluded_chips()
+    # attribution is exact: no other chip accrued anything
+    assert all(reg.chip_state(c) == health.STATE_HEALTHY
+               for c in range(8) if c != 3)
+
+
+@pytest.mark.slow
+def test_probation_probe_gated_on_latency(monkeypatch):
+    """Round 18 probation: a probe that answers CORRECTLY but over the
+    latency gate must fail probation — a straggler cannot talk its way
+    back in by being right slowly.  With the fault lifted the same
+    chip walks the clean-probe streak back to healthy.  Slow-marked
+    (real probe dispatches + compiles, ~25 s): tier-1 keeps the cheap
+    gate pins below; the faults CI job and tools/straggler_lab.py run
+    this flow end to end."""
+    pytest.importorskip("jax")
+    clock = health.FakeClock()
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    chip = 2
+    reg.record_suspicion(chip, 3.0, "test quarantine")
+    assert reg.chip_state(chip) == health.STATE_QUARANTINED
+    clock.advance(6 * config.get("ED25519_TPU_SUSPICION_HALF_LIFE"))
+    assert reg.chip_state(chip) == health.STATE_PROBATION
+    feed_healthy(reg.latency)  # gate = 3x the 10 ms median
+    assert reg.latency.gate_us() == 30000
+
+    plan = faults.FaultPlan(
+        [faults.SlowChip(chip, SLOW, site=faults.SITE_LANE)], seed=1)
+    pv = make_verifiers(1)[0]
+    with faults.injected(plan):
+        assert batch.run_probation_probe(pv, chip, rng=rng) is False
+    assert reg.chip_state(chip) != health.STATE_HEALTHY
+
+    # fault lifted: clean in-gate probes rejoin the chip
+    clock.advance(6 * config.get("ED25519_TPU_SUSPICION_HALF_LIFE"))
+    assert reg.chip_state(chip) == health.STATE_PROBATION
+    for i in range(config.get("ED25519_TPU_PROBATION_PROBES")):
+        assert batch.run_probation_probe(
+            make_verifiers(1)[0], chip, rng=rng) is True
+    assert reg.chip_state(chip) == health.STATE_HEALTHY
+
+
+# -- hedged re-dispatch (scheduler seam) -----------------------------------
+
+def run_hedged_wedged(vs, monkeypatch, deadline_in=None, chunk=2):
+    """Force-hedge a forced-device call whose device leg is wedged
+    behind the device-call lock: the twin must fully overtake every
+    chunk, deterministically.  The installed ErrorOn keeps the late
+    (post-release, already-discarded) device call cheap."""
+    monkeypatch.setenv("ED25519_TPU_HEDGE_MIN_MS", "0")
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=0, clock=clock)
+    health.chip_registry().set_clock(clock)
+    plan = faults.FaultPlan(
+        [faults.ErrorOn(on=lambda i: True, site=faults.SITE_LANE)],
+        seed=2)
+    deadline = (clock.monotonic() + deadline_in
+                if deadline_in is not None else None)
+    with faults.injected(plan):
+        with msm.DEVICE_CALL_LOCK:
+            got = batch.verify_many(
+                vs, rng=rng, chunk=chunk, hybrid=False, merge="never",
+                mesh=0, health=hp, deadline=deadline)
+        # If the worker popped the chunk before the twin discarded it,
+        # its late call lands AFTER the lock releases; hold the plan
+        # installed until that call has hit the fault seam (ErrorOn,
+        # instant) — otherwise the loser compiles a real kernel.  When
+        # the worker instead CONSUMED the discard pre-call (it empties
+        # lane._discarded and skips the dispatch), no late call is
+        # coming — waiting out the timeout would burn 5 s for nothing.
+        lane = batch._DeviceLane._instances.get(0)
+        t_end = time.monotonic() + 5.0
+        while (plan.calls_seen(faults.SITE_LANE) == 0
+               and lane is not None and lane._discarded
+               and time.monotonic() < t_end):
+            time.sleep(0.002)
+    return got, dict(batch.last_run_stats), clock, deadline
+
+
+def test_hedge_twin_first_valid_wins_loser_unread(monkeypatch):
+    """First-valid-wins: the twin decides every batch, the device leg
+    is discarded UNREAD (zero device-decided batches), and the pair's
+    counters balance."""
+    vs = make_verifiers(2, bad={1})
+    got, st, _clock, _dl = run_hedged_wedged(vs, monkeypatch)
+    assert got == [True, False]
+    assert st["hedges_fired"] == 1 and st["hedges_won"] == 1
+    assert st["hedges_lost"] == 0
+    assert (st["device_batches"] + st["device_rejects_confirmed"]
+            + st["device_rejects_overturned"]) == 0
+
+
+def test_hedge_decides_tight_deadline_inside_deadline(monkeypatch):
+    """The hedge-under-deadline contract: a tight-deadline call fully
+    decided by the twin returns INSIDE its deadline on the virtual
+    clock (nothing on the twin path advances it)."""
+    vs = make_verifiers(2)
+    got, st, clock, deadline = run_hedged_wedged(vs, monkeypatch,
+                                                 deadline_in=0.5)
+    assert got == [True, True]
+    assert st["hedges_won"] == 1
+    assert clock.monotonic() <= deadline
+
+
+def test_hedge_twin_restages_with_fresh_blinders(monkeypatch):
+    """The twin is RE-verification, not result transfer: it routes
+    through _host_verdict, which stages with fresh RLC blinders from
+    the call rng — a hedge pair can never mix partial results."""
+    calls = []
+    real = batch._host_verdict
+
+    def spy(v, r):
+        calls.append(v)
+        return real(v, r)
+
+    monkeypatch.setattr(batch, "_host_verdict", spy)
+    vs = make_verifiers(2)
+    got, st, _clock, _dl = run_hedged_wedged(vs, monkeypatch)
+    assert got == [True, True]
+    assert st["hedges_won"] == 1
+    # every batch the twin decided went through a fresh host staging
+    assert set(map(id, calls)) == set(map(id, vs))
+
+
+def test_hedge_budget_bounds_concurrent_hedges(monkeypatch):
+    """HEDGE_BUDGET chunks at most carry a twin at once; 0 disables
+    hedging entirely (maybe_hedge never fires, stats stay zero)."""
+    monkeypatch.setenv("ED25519_TPU_HEDGE_BUDGET", "1")
+    vs = make_verifiers(4)
+    got, st, _clock, _dl = run_hedged_wedged(vs, monkeypatch, chunk=2)
+    assert got == [True] * 4
+    # two chunks existed, one budget slot: the slot is freed when a
+    # pair resolves, so both eventually hedge but never concurrently
+    assert st["hedges_fired"] == 2
+    assert st["hedges_won"] == 2
+
+
+def test_straggler_counters_ride_service_totals(monkeypatch):
+    """The stats/gauges satellite: hedge + straggler counters surface
+    in last_run_stats with zero values even on a pure run."""
+    vs = make_verifiers(2)
+    got, st, _clock, _dl = run_hedged_wedged(vs, monkeypatch)
+    for k in ("hedges_fired", "hedges_won", "hedges_lost",
+              "straggler_suspicion_events"):
+        assert k in st
+    assert st["straggler_suspicion_events"] == 0

@@ -21,6 +21,9 @@ Storm profiles (--storm; faults.storm_plan + request-side schedules):
 * ``none``     — pure overload: no device faults, capacity pressure only.
 * ``stall``    — a stall storm at the lane dispatch (calls sleep past the
   scheduler's 2 s deadline floor → deadline misses, breaker food).
+* ``slowchip`` — a GRAY window (round 18): a few mid-round device calls
+  run 0.25 s slow — correct verdicts, late; the latency ledger accrues
+  straggler evidence on a live service and nothing sheds or wedges.
 * ``death``    — device death mid-queue (KillLane; the lane worker dies
   with chunks in flight, replacement lanes die on the storm's window).
 * ``error``    — a crash storm (every call in the window raises).
@@ -104,14 +107,28 @@ def storm_for(profile, seed, site):
         # so the window deterministically blows deadlines
         return faults.storm_plan(seed, "stall", at=1, length=3,
                                  site=site)
+    if profile == "slowchip":
+        # Gray window (round 18): a few mid-round device calls run
+        # slow — not dead.  Verdicts keep landing (late and correct),
+        # the latency ledger accrues real straggler evidence on a live
+        # service, and the drain never wedges behind the slow calls.
+        # 0.25 s is well inside every non-tight deadline: the gate is
+        # still zero lost + host-identical, nothing sheds.
+        return faults.storm_plan(seed, "slow", at=1, length=4,
+                                 seconds=0.25, site=site)
     if profile == "death":
         return faults.storm_plan(seed, "crash", at=1, length=2)
     if profile == "error":
         return faults.storm_plan(seed, "error", at=0, length=6, site=site)
     if profile == "mixed":
+        # slow_rate (round 18): the mixed storm's gray window — a drawn
+        # subset of calls run 0.25 s late-but-correct on chip 0, so the
+        # long-standing zero-lost/host-identical gate covers gray
+        # failure alongside errors/stalls/corruption.
         return faults.randomized_plan(seed, error_rate=0.2,
                                       stall_rate=0.1, stall_seconds=0.3,
-                                      corrupt_rate=0.1, site=site)
+                                      corrupt_rate=0.1, slow_rate=0.1,
+                                      site=site)
     raise SystemExit(f"unknown storm profile {profile!r}")
 
 
@@ -276,7 +293,8 @@ def main(argv=None):
     ap.add_argument("--mesh", type=int, default=0)
     ap.add_argument("--storm", default="mixed",
                     choices=["none", "stall", "death", "error",
-                             "deadline", "mixed", "churn"])
+                             "deadline", "mixed", "churn",
+                             "slowchip"])
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--max-waivers", type=int, default=8,
                     help="consensuslint waiver ratchet: fail the soak if "
@@ -363,7 +381,8 @@ def main(argv=None):
                   f"(stats={st}) — residency never exercised",
                   file=sys.stderr)
             violations += 1
-    if args.storm in ("stall", "death", "error", "mixed", "churn") \
+    if args.storm in ("stall", "death", "error", "mixed", "churn",
+                      "slowchip") \
             and totals["injected"] == 0:
         # A device-fault storm that never injected tested nothing — a
         # soak must not print a false green on the acceptance bar.

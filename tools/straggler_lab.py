@@ -1,0 +1,478 @@
+"""Straggler lab: the gray-failure CI gate (round 18).
+
+The mesh survives chips that fail LOUDLY — typed errors, chip loss,
+corrupt partials — but a chip that merely runs 10x slow trips nothing:
+the breaker sees successes, the classifier sees no exception, and
+every wave placed on it inherits its latency.  This lab proves the
+latency half of the health subsystem end to end on a FakeClock, with
+REAL forced-device dispatches on the virtual mesh (the fault seam
+advances the virtual clock, so a modelled 10x is exactly 10x and the
+run is a pure function of the seed).  Three phases:
+
+**Phase A — persistent straggler.**  Every dispatch pays a modelled
+base cost (`StallFor` on the lane seam); one chip pays 10x
+(`faults.SlowChip`).  A forced-device sweep (one single-chip dispatch
+per chip per round — placement DIVERSITY is where attribution
+exactness comes from, exactly like round-10 ambiguity smearing) feeds
+the latency ledger.  Gates:
+
+* the straggler is attributed EXACTLY — ledger straggler streaks
+  complete on the slow chip and no other, and the suspicion ladder
+  quarantines that chip and no other;
+* quarantine lands within a BOUNDED number of sweep rounds (streak
+  arithmetic over the knobs, plus decay slack — bounded, not
+  eventual);
+* after quarantine the consensus p99 over the surviving chips
+  recovers to <= 1.3x the healthy-mesh baseline measured before the
+  fault (the tentpole's SLO claim: slow-is-the-new-down);
+* every verdict in every phase is bit-identical to the host oracle,
+  zero lost — latency evidence gates placement and timing, never
+  math.
+
+**Phase B — gray flap.**  The same chip alternates slow/normal windows
+(`faults.GrayFlap`, one window per sweep round).  Windows shorter than
+ED25519_TPU_STRAGGLER_MIN_SAMPLES must never complete a straggler
+streak: the gate is ZERO suspicion accruals and zero quarantine
+transitions — a ladder that flapped here would thrash devcache
+residency and reformation for no verdict benefit.
+
+**Phase C — hedged re-dispatch.**  Force-hedge (HEDGE_MIN_MS=0) plus a
+tight-deadline consensus call whose device leg is wedged behind the
+device-call lock: the hedge twin re-verifies the chunk with fresh
+blinders and fully overtakes it, the call returns INSIDE its deadline
+on the virtual clock, and the device leg is discarded UNREAD (the
+lane skips a discarded chunk without ever entering the call — zero
+device-decided batches is asserted from stats).  A second, racing
+variant corrupts every device result (`faults.CorruptSum`): whichever
+leg lands first, verdicts stay bit-identical to the host oracle —
+fault-marked loser results are never published, because a corrupted
+device sum can only manufacture REJECTS (re-decided on the host) and
+accepts require the cofactored identity.
+
+Usage:
+  python tools/straggler_lab.py [--seed N] [--devices 8] [--chip 5]
+      [--json]
+
+Exit status is nonzero unless every gate holds.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ed25519_consensus_tpu import (  # noqa: E402
+    SigningKey, batch, config, devcache, faults, health, tenancy,
+)
+from ed25519_consensus_tpu.ops import msm  # noqa: E402
+
+_stable_seed = tenancy._stable_seed
+
+# The virtual cost model: every lane call pays BASE_S (the StallFor
+# floor on the seam); the gray chip pays BASE_S + SLOW_S = 10x.  On a
+# FakeClock real compute is invisible, so the ratio is exact.
+BASE_S = 0.010
+SLOW_S = 0.090
+
+
+# Scoped knob overrides go through config.override — the registry is
+# the one sanctioned env toucher (consensuslint CL003).
+_knobs = config.override
+
+
+def make_wave(seed, keys, tag, n_batches=2, bad_rate=0.25):
+    """A keyset-uniform wave of verifiers plus its host-oracle truth
+    (the sentinel_soak construction): seeded tampering keeps REAL
+    False verdicts flowing through the straggler machinery."""
+    vs, want = [], []
+    for b in range(n_batches):
+        rnd = random.Random(_stable_seed(seed, "wave", tag, b))
+        bad = rnd.random() < bad_rate
+        v = batch.Verifier()
+        for j, sk in enumerate(keys):
+            msg = b"straggler-lab %s %d %d" % (tag.encode(), b, j)
+            sig = sk.sign(msg if not (bad and j == 0) else b"tampered")
+            v.queue((sk.verification_key_bytes(), sig, msg))
+        vs.append(v)
+        want.append(not bad)
+    return vs, want
+
+
+def premark_shapes(seed, keys):
+    """Pre-mark the single-device chunk shape compile-complete so the
+    lab exercises the LATENCY machinery, not the compile-grace
+    machinery (the mesh_chaos.py discipline).  Every dispatch here is
+    a forced single-chip call (mesh rung 0)."""
+    probe, _ = make_wave(seed, keys, "shape-probe", n_batches=1,
+                         bad_rate=0.0)
+    n_terms = probe[0]._stage(None).n_device_terms
+    msm.mark_shape_completed(2, msm.preferred_pad(n_terms), 0)
+
+
+def quantile_us(durations_us, q_milli):
+    """Nearest-rank quantile over integer-microsecond durations — the
+    ledger's own convention, applied to the lab's wave measurements."""
+    if not durations_us:
+        return 0
+    s = sorted(durations_us)
+    return s[(q_milli * (len(s) - 1)) // 1000]
+
+
+def quarantine_round_bound() -> int:
+    """The bounded-detection claim, from the knobs: a persistent
+    straggler completes one streak every MIN_SAMPLES of its dispatches
+    (one per sweep round), needs ceil(threshold / STRAGGLER_SUSPICION)
+    completed streaks to cross the ladder threshold, plus one extra
+    streak of slack for suspicion decay between accruals (the registry
+    clock keeps running during the storm)."""
+    thr = config.get("ED25519_TPU_SUSPICION_THRESHOLD")
+    need = max(1, int(config.get("ED25519_TPU_STRAGGLER_MIN_SAMPLES")))
+    events = max(1, -(-int(thr * 1000)
+                      // int(health.STRAGGLER_SUSPICION * 1000)))
+    return need * (events + 2)
+
+
+def run_wave(seed, keys, tag, hp, rng, chip, bad_rate=0.25,
+             deadline=None):
+    """One forced-single-chip wave; returns (host_identical, zero_lost,
+    duration_us on the virtual clock, stats)."""
+    vs, want = make_wave(seed, keys, tag, bad_rate=bad_rate)
+    t0 = hp.clock.monotonic()
+    got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                            merge="never", mesh=0, health=hp,
+                            device_ids=(chip,), deadline=deadline)
+    dt_us = int(round((hp.clock.monotonic() - t0) * 1000000))
+    return (got == want, len(got) == len(want), dt_us,
+            dict(batch.last_run_stats))
+
+
+def sweep(seed, keys, tag, hp, rng, chips, results, bad_rate=0.25):
+    """One round: a forced wave on every chip in `chips`.  Appends
+    integer-us durations to `results` and returns (all host-identical,
+    none lost)."""
+    identical = lost_none = True
+    for c in chips:
+        ok, nolost, dt_us, _st = run_wave(
+            seed, keys, "%s-c%d" % (tag, c), hp, rng, c,
+            bad_rate=bad_rate)
+        results.append(dt_us)
+        identical = identical and ok
+        lost_none = lost_none and nolost
+    return identical, lost_none
+
+
+def run_persistent_straggler(seed, devices=8, chip=5) -> dict:
+    """Phase A (see module docstring)."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=0, clock=clock)
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(enabled=False))
+    rnd = random.Random(_stable_seed(seed, "keys"))
+    keys = [SigningKey.new(rnd) for _ in range(4)]
+    rng = random.Random(_stable_seed(seed, "rng"))
+    premark_shapes(seed, keys)
+
+    bound = quarantine_round_bound()
+    results = {"ok": True, "chip": chip, "round_bound": bound}
+    all_chips = tuple(range(devices))
+    try:
+        # Healthy baseline: every chip pays the modelled base cost.
+        base_plan = faults.FaultPlan(
+            [faults.StallFor(BASE_S, on=lambda i: True,
+                             site=faults.SITE_LANE)], seed=seed)
+        healthy_us, identical, lost_none = [], True, True
+        with faults.injected(base_plan):
+            for r in range(2):
+                ok_r, nl_r = sweep(seed, keys, "base-%d" % r, hp, rng,
+                                   all_chips, healthy_us)
+                identical, lost_none = (identical and ok_r,
+                                        lost_none and nl_r)
+        healthy_p99 = quantile_us(healthy_us, 990)
+        results["healthy_p99_us"] = healthy_p99
+
+        # The gray storm: same base cost, one chip at 10x.
+        plan = faults.slow_plan(seed, chip, SLOW_S, base_seconds=BASE_S)
+        detected_at = None
+        storm_us = []
+        with faults.injected(plan):
+            for r in range(bound):
+                ok_r, nl_r = sweep(seed, keys, "storm-%d" % r, hp, rng,
+                                   all_chips, storm_us)
+                identical, lost_none = (identical and ok_r,
+                                        lost_none and nl_r)
+                if reg.chip_state(chip) == health.STATE_QUARANTINED:
+                    detected_at = r
+                    break
+            # Post-quarantine recovery: the straggler is OUT of
+            # placement, the surviving chips carry consensus at the
+            # healthy cost.
+            survivors = tuple(c for c in all_chips
+                              if c not in reg.excluded_chips())
+            post_us = []
+            for r in range(3):
+                ok_r, nl_r = sweep(seed, keys, "post-%d" % r, hp, rng,
+                                   survivors, post_us)
+                identical, lost_none = (identical and ok_r,
+                                        lost_none and nl_r)
+        post_p99 = quantile_us(post_us, 990)
+
+        events = {c: st["straggler_events"]
+                  for c, st in reg.latency.chip_stats().items()
+                  if st["straggler_events"]}
+        results.update({
+            "detected_at_round": detected_at,
+            "quarantined_within_bound": detected_at is not None,
+            "straggler_events": events,
+            "attribution_exact": set(events) == {chip},
+            "quarantine_exact": reg.excluded_chips() == {chip},
+            "survivors": len(survivors),
+            "consensus_p99_us": post_p99,
+            # Integer-scaled 1.3x compare, the ledger discipline.
+            "p99_recovered": post_p99 * 10 <= healthy_p99 * 13,
+            "host_identical": identical,
+            "zero_lost": lost_none,
+        })
+        results["ok"] = all((
+            results["quarantined_within_bound"],
+            results["attribution_exact"],
+            results["quarantine_exact"],
+            results["p99_recovered"],
+            identical, lost_none,
+        ))
+    finally:
+        devcache.set_default_cache(None)
+        batch.reset_device_health()
+    return results
+
+
+def run_gray_flap(seed, devices=8, chip=5) -> dict:
+    """Phase B (see module docstring).  `period=devices` aligns one
+    flap window with one sweep round (the fault's window is a pure
+    function of the per-site call index; one round = `devices` lane
+    calls), so the chip alternates slow round / normal round — the
+    shortest flap the sweep can express, well under MIN_SAMPLES."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=0, clock=clock)
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(enabled=False))
+    rnd = random.Random(_stable_seed(seed, "keys"))
+    keys = [SigningKey.new(rnd) for _ in range(4)]
+    rng = random.Random(_stable_seed(seed, "rng-flap"))
+    premark_shapes(seed, keys)
+
+    results = {"ok": True, "chip": chip}
+    all_chips = tuple(range(devices))
+    rounds = 3 * max(
+        1, int(config.get("ED25519_TPU_STRAGGLER_MIN_SAMPLES")))
+    try:
+        plan = faults.slow_plan(seed, chip, SLOW_S, base_seconds=BASE_S,
+                                kind="flap", period=devices)
+        identical = lost_none = True
+        never_excluded = True
+        flap_us = []
+        with faults.injected(plan):
+            for r in range(rounds):
+                ok_r, nl_r = sweep(seed, keys, "flap-%d" % r, hp, rng,
+                                   all_chips, flap_us)
+                identical, lost_none = (identical and ok_r,
+                                        lost_none and nl_r)
+                never_excluded = (never_excluded
+                                  and not reg.excluded_chips())
+        events = sum(st["straggler_events"]
+                     for st in reg.latency.chip_stats().values())
+        results.update({
+            "rounds": rounds,
+            "straggler_events": events,
+            "no_accrual": events == 0,
+            "never_excluded": never_excluded,
+            "state": reg.chip_state(chip),
+            "host_identical": identical,
+            "zero_lost": lost_none,
+        })
+        results["ok"] = all((
+            events == 0, never_excluded,
+            reg.chip_state(chip) == health.STATE_HEALTHY,
+            identical, lost_none,
+        ))
+    finally:
+        devcache.set_default_cache(None)
+        batch.reset_device_health()
+    return results
+
+
+def run_hedge_phase(seed, devices=8, chip=1) -> dict:
+    """Phase C (see module docstring)."""
+    clock = health.FakeClock()
+    hp = health.DeviceHealth(mesh=0, clock=clock)
+    reg = health.chip_registry()
+    reg.set_clock(clock)
+    devcache.set_default_cache(
+        devcache.DeviceOperandCache(enabled=False))
+    rnd = random.Random(_stable_seed(seed, "keys"))
+    keys = [SigningKey.new(rnd) for _ in range(4)]
+    rng = random.Random(_stable_seed(seed, "rng-hedge"))
+    premark_shapes(seed, keys)
+
+    results = {"ok": True, "chip": chip}
+    try:
+        # C1: tight-deadline consensus call, device leg wedged behind
+        # the device-call lock (the shape of a seized tunnel).  The
+        # hedge twin must fully overtake the chunk INSIDE the deadline
+        # on the virtual clock, and the wedged leg must be discarded
+        # unread — the lane skips a discarded chunk without entering
+        # the call, so zero device-decided batches is the proof.
+        vs, want = make_wave(seed, keys, "hedge-deadline")
+        deadline = clock.monotonic() + 0.5
+        with msm.DEVICE_CALL_LOCK:
+            got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                    merge="never", mesh=0, health=hp,
+                                    device_ids=(chip,),
+                                    deadline=deadline)
+        st = dict(batch.last_run_stats)
+        inside = clock.monotonic() <= deadline
+        device_touched = (st["device_batches"]
+                          + st["device_rejects_confirmed"]
+                          + st["device_rejects_overturned"])
+        results["deadline"] = {
+            "want": want, "got": got,
+            "hedges_fired": st["hedges_fired"],
+            "hedges_won": st["hedges_won"],
+            "hedges_lost": st["hedges_lost"],
+            "inside_deadline": inside,
+            "device_decided_batches": device_touched,
+            "ok": (got == want and inside
+                   and st["hedges_fired"] == 1
+                   and st["hedges_won"] == 1
+                   and st["hedges_lost"] == 0
+                   and device_touched == 0),
+        }
+        results["ok"] = results["ok"] and results["deadline"]["ok"]
+
+        # C2: both legs genuinely racing, every device result
+        # fault-marked (CorruptSum).  A short REAL-time wedge
+        # guarantees the twin fires before the device leg can land;
+        # after release the legs race.  Whichever wins, verdicts stay
+        # the host oracle's: the fault-marked loser is never
+        # published.
+        corrupt_plan = faults.FaultPlan(
+            [faults.CorruptSum(on=lambda i: True,
+                               site=faults.SITE_LANE)], seed=seed)
+        vs, want = make_wave(seed, keys, "hedge-race", bad_rate=0.5)
+
+        def _wedge():
+            with msm.DEVICE_CALL_LOCK:
+                time.sleep(0.25)
+
+        holder = threading.Thread(target=_wedge, daemon=True)
+        holder.start()
+        time.sleep(0.05)  # the wedge owns the lock before the submit
+        with faults.injected(corrupt_plan):
+            got = batch.verify_many(vs, rng=rng, chunk=2, hybrid=False,
+                                    merge="never", mesh=0, health=hp,
+                                    device_ids=(chip,))
+        holder.join(timeout=30.0)
+        st = dict(batch.last_run_stats)
+        results["race"] = {
+            "want": want, "got": got,
+            "hedges_fired": st["hedges_fired"],
+            "hedges_resolved": st["hedges_won"] + st["hedges_lost"],
+            "device_accepts": st["device_batches"],
+            "rejects_overturned": st["device_rejects_overturned"],
+            "ok": (got == want
+                   and st["hedges_fired"] >= 1
+                   and (st["hedges_won"] + st["hedges_lost"]
+                        == st["hedges_fired"])
+                   # a corrupted sum can never clear the cofactored
+                   # identity check: zero device-decided accepts.
+                   and st["device_batches"] == 0),
+        }
+        results["ok"] = results["ok"] and results["race"]["ok"]
+    finally:
+        devcache.set_default_cache(None)
+        batch.reset_device_health()
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=lambda s: int(s, 0),
+                    default=config.get("ED25519_TPU_STRAGGLER_LAB_SEED"))
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--chip", type=int, default=5,
+                    help="the gray-failing chip (phases A and B)")
+    ap.add_argument("--json", action="store_true")
+    cfg = ap.parse_args(argv)
+
+    try:
+        import jax
+
+        n = len(jax.devices())
+    except (ImportError, RuntimeError):
+        n = 0
+    if n < cfg.devices:
+        print(f"straggler_lab: need {cfg.devices} devices, have {n} "
+              f"(run with XLA_FLAGS=--xla_force_host_platform_"
+              f"device_count={cfg.devices})", file=sys.stderr)
+        os._exit(2)
+
+    summary = {"seed": cfg.seed, "devices": cfg.devices, "ok": True}
+    # MIN_SAMPLES=4 is the lab's operating point (half the default):
+    # the streak arithmetic under test is knob-relative, and the
+    # shorter streak halves the forced-dispatch count per phase.
+    # Hedging is OFF for phases A/B so the ladder is measured in
+    # isolation; phase C force-hedges (MIN_MS=0).
+    with _knobs(ED25519_TPU_HEDGE_BUDGET=0,
+                ED25519_TPU_STRAGGLER_MIN_SAMPLES=4):
+        summary["persistent"] = run_persistent_straggler(
+            cfg.seed, devices=cfg.devices, chip=cfg.chip)
+        summary["ok"] = summary["ok"] and summary["persistent"]["ok"]
+        summary["flap"] = run_gray_flap(
+            cfg.seed, devices=cfg.devices, chip=cfg.chip)
+        summary["ok"] = summary["ok"] and summary["flap"]["ok"]
+    with _knobs(ED25519_TPU_HEDGE_MIN_MS=0,
+                ED25519_TPU_STRAGGLER_MIN_SAMPLES=4):
+        summary["hedge"] = run_hedge_phase(cfg.seed,
+                                           devices=cfg.devices)
+        summary["ok"] = summary["ok"] and summary["hedge"]["ok"]
+
+    if cfg.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    pers = summary["persistent"]
+    # The bench-harvest line: the headline is how fast a gray chip is
+    # diagnosed and how fully the consensus tail recovers.
+    print(json.dumps({
+        "metric": "straggler_lab",
+        "value": pers.get("detected_at_round"),
+        "unit": "rounds_to_quarantine_persistent_straggler",
+        "round_bound": pers.get("round_bound"),
+        "attribution_exact": pers.get("attribution_exact"),
+        "healthy_p99_us": pers.get("healthy_p99_us"),
+        "consensus_p99_us": pers.get("consensus_p99_us"),
+        "p99_recovered": pers.get("p99_recovered"),
+        "flap_accruals": summary["flap"].get("straggler_events"),
+        "hedge_inside_deadline": summary["hedge"].get(
+            "deadline", {}).get("inside_deadline"),
+        "ok": summary["ok"],
+    }))
+    print("STRAGGLER_LAB", json.dumps(summary))
+    if not summary["ok"]:
+        print(f"VIOLATION: straggler_lab gates failed "
+              f"(replay with --seed {cfg.seed:#x})", file=sys.stderr)
+    sys.stdout.flush()
+    # Same teardown discipline as the other labs: never let interpreter
+    # finalization run with a lane worker parked in the runtime.
+    batch._DeviceLane.reset_all(timeout=30.0)
+    os._exit(0 if summary["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

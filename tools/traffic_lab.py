@@ -266,6 +266,29 @@ def run_lab(cfg) -> dict:
     last_gen = {}
     busy_until = [None]
 
+    # Gray-failure cost model (round 18, --slow-chip): a seeded
+    # fraction of waves land on a placement whose gray chip straggles —
+    # the wave's virtual cost multiplies by `factor`.  With hedging ON
+    # the model mirrors the armed scheduler (batch.maybe_hedge): once
+    # the wave ring is warm, a wave overrunning ratio × median of
+    # recent wave costs re-dispatches its batches on the host and
+    # completes at the threshold plus one host re-verify —
+    # first-valid-wins caps the tail.  The gray draw is a pure function
+    # of (seed, wave ordinal), so the hedging-on and hedging-off
+    # variants grey out the SAME waves.
+    gray_model = getattr(cfg, "gray_model", None)
+    gray_rnd = (random.Random(_stable_seed(cfg.seed, "gray"))
+                if gray_model else None)
+    # The threshold ring starts with a seeded prior (the scenario's
+    # expected one-batch wave cost): production services cross the
+    # scheduler's arming window within their first few seconds, while
+    # the storm models hours — cold-start blast is not the claim under
+    # test here (tests/test_scheduler.py pins the real arming rule).
+    gray_costs = [cfg.wave_overhead * t_cap + mean_sigs / rate] * 8 \
+        if gray_model else []
+    gray_stats = {"gray_waves": 0, "hedges_fired": 0,
+                  "hedge_saved_s": 0.0}
+
     def submit_one(t, si, seq):
         req, gen = build_request(matrix, cfg.seed, si, seq, t,
                                  rotate_every, t_cap, t0)
@@ -301,6 +324,21 @@ def run_lab(cfg) -> dict:
                 r.done_at = now
         cost = (cfg.wave_overhead * t_cap + live_sigs / rate
                 if live_sigs else 0.0)
+        if gray_model and live_sigs:
+            if gray_rnd.random() < gray_model["frac"]:
+                gray_stats["gray_waves"] += 1
+                slow_cost = cost * gray_model["factor"]
+                if gray_model["hedging"]:
+                    recent = sorted(gray_costs[-128:])
+                    median = recent[(len(recent) - 1) // 2]
+                    thr = gray_model["ratio"] * median
+                    if slow_cost > thr:
+                        hedged = thr + live_sigs / rate
+                        gray_stats["hedges_fired"] += 1
+                        gray_stats["hedge_saved_s"] += slow_cost - hedged
+                        slow_cost = hedged
+                cost = slow_cost
+            gray_costs.append(cost)
         done_at = now + cost
         for r in resolved:
             if r.kind == "verdict":
@@ -337,8 +375,16 @@ def run_lab(cfg) -> dict:
         devcache.set_default_cache(None)
         verdictcache.set_default_cache(None)
 
-    return summarize(cfg, matrix, requests, svc, cache, rate,
-                     capacity_sigs, t_cap, horizon, t0)
+    summary = summarize(cfg, matrix, requests, svc, cache, rate,
+                        capacity_sigs, t_cap, horizon, t0)
+    if gray_model:
+        summary["gray_failure_run"] = dict(
+            gray_stats,
+            hedge_saved_s=round(gray_stats["hedge_saved_s"], 6),
+            hedging=gray_model["hedging"],
+            factor=gray_model["factor"], frac=gray_model["frac"],
+            ratio=gray_model["ratio"])
+    return summary
 
 
 def summarize(cfg, matrix, requests, svc, cache, rate, capacity_sigs,
@@ -812,6 +858,90 @@ def run_load_sweep(cfg, loads: "list[float]") -> dict:
     }
 
 
+def run_gray_failure(cfg) -> dict:
+    """Round 18 (--slow-chip): the gray-failure variant pair.  Drive
+    the SAME seeded open-loop scenario through the slow-chip cost
+    model twice — hedging OFF, then hedging ON — and emit the
+    comparison as a first-class block inside the `service_slo` bench
+    artifact.  The claim under test is the tentpole's: with a gray
+    chip straggling on a seeded fraction of waves, hedged re-dispatch
+    (first valid result wins) keeps consensus-class p99 inside its
+    deadline, while the un-hedged variant eats the full straggler tail.
+    Invariant gates (zero lost, host-identical verdicts, consensus
+    never shed) must hold in BOTH variants — hedging buys latency,
+    never correctness."""
+    rate = cfg.service_rate or calibrate_service_rate(cfg.seed)
+    ratio = config.get("ED25519_TPU_STRAGGLER_RATIO")
+    variants = {}
+    invariants_ok = True
+    # The storm point sits below the 0.8 envelope point: hedged
+    # re-dispatch SPENDS spare capacity to buy tail latency (every
+    # hedge re-verifies real work), so a mesh with no headroom has
+    # nothing to hedge with.  0.6 models the provisioning a consensus
+    # operator actually runs with.
+    load = min(cfg.load, 0.6)
+    for hedging in (False, True):
+        v_cfg = argparse.Namespace(**vars(cfg))
+        v_cfg.service_rate = rate  # one calibration for the pair
+        v_cfg.load = load
+        v_cfg.require_rpc_shed = False
+        v_cfg.gray_model = {
+            "frac": cfg.gray_frac, "factor": cfg.slow_factor,
+            "ratio": ratio, "hedging": hedging,
+        }
+        summary = run_lab(v_cfg)
+        # Zero lost and host-identical verdicts hold in BOTH variants —
+        # hedging buys latency, never correctness.  Consensus shed rate
+        # is deliberately NOT an invariant here: the un-hedged variant
+        # blowing consensus deadlines IS the gray-failure finding.
+        invariants = {
+            "zero_lost": summary["gates"]["zero_lost"],
+            "host_identical_verdicts":
+                summary["gates"]["host_identical_verdicts"],
+        }
+        invariants_ok = invariants_ok and all(invariants.values())
+        cons = summary["by_class"][tenancy.CLASS_CONSENSUS]
+        variants["hedging_on" if hedging else "hedging_off"] = {
+            "consensus_p50_s": cons["latency_s"]["p50"],
+            "consensus_p99_s": cons["latency_s"]["p99"],
+            "consensus_deadline_s": cons["deadline_s"],
+            "consensus_shed_rate": cons["shed_rate"],
+            "p99_under_deadline":
+                summary["gates"]["consensus_p99_under_deadline"],
+            "shed_rate_by_class": {
+                c: summary["by_class"][c]["shed_rate"]
+                for c in tenancy.CLASSES},
+            "invariants": invariants,
+            **summary["gray_failure_run"],
+        }
+    on, off = variants["hedging_on"], variants["hedging_off"]
+    gates = {
+        "invariants_hold_both_variants": invariants_ok,
+        "storm_landed_in_both": (on["gray_waves"] > 0
+                                 and off["gray_waves"] > 0),
+        "hedges_fired_only_when_armed": (
+            on["hedges_fired"] > 0 and off["hedges_fired"] == 0),
+        # With hedging, consensus rides out the gray chip: never shed,
+        # p99 inside the deadline.
+        "hedged_consensus_never_shed": on["consensus_shed_rate"] == 0.0,
+        "hedged_consensus_p99_under_deadline": on["p99_under_deadline"],
+        # Without it, the straggler tail is real damage: consensus
+        # deadline-sheds or blows its p99.
+        "unhedged_tail_blows": (off["consensus_shed_rate"] > 0.0
+                                or not off["p99_under_deadline"]),
+    }
+    return {
+        "ok": all(gates.values()),
+        "gates": gates,
+        "load": load,
+        "slow_factor": cfg.slow_factor,
+        "gray_frac": cfg.gray_frac,
+        "straggler_ratio": ratio,
+        "service_rate_sigs_per_s": round(rate, 1),
+        "variants": variants,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=lambda s: int(s, 0),
@@ -861,6 +991,21 @@ def main(argv=None):
     ap.add_argument("--affinity-target", type=float, default=0.6,
                     help="fleet mode: minimum acceptable affinity "
                          "hit-rate (overall and post-rejoin tail)")
+    ap.add_argument("--slow-chip", action="store_true",
+                    help="gray-failure storm: run the seeded scenario "
+                         "through the slow-chip cost model twice — "
+                         "hedging off vs on — and emit the comparison "
+                         "as a gray_failure block inside service_slo "
+                         "(gates: invariants hold in both, hedged "
+                         "consensus p99 under deadline, hedging "
+                         "recovers the tail)")
+    ap.add_argument("--slow-factor", type=float, default=6.0,
+                    help="--slow-chip: straggler cost multiplier on a "
+                         "gray wave")
+    ap.add_argument("--gray-frac", type=float, default=0.125,
+                    help="--slow-chip: seeded fraction of waves whose "
+                         "placement hits the gray chip (1/8 = one "
+                         "chip of an 8-chip mesh)")
     ap.add_argument("--load-sweep", default="",
                     help="drive the load axis and emit the latency-vs-"
                          "load curve into the service_slo block: a "
@@ -931,6 +1076,12 @@ def main(argv=None):
         summary["load_sweep"] = sweep
         summary["ok"] = summary["ok"] and sweep["ok"]
 
+    gray = None
+    if cfg.slow_chip:
+        gray = run_gray_failure(cfg)
+        summary["gray_failure"] = gray
+        summary["ok"] = summary["ok"] and gray["ok"]
+
     if cfg.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
     cons = summary["by_class"][tenancy.CLASS_CONSENSUS]
@@ -958,6 +1109,9 @@ def main(argv=None):
         # item 3 follow-up): consensus p50/p99 + per-class shed rates
         # per offered-load point, invariant-gated at every point.
         "load_sweep": (sweep["curve"] if sweep else None),
+        # The gray-failure variant pair (--slow-chip, round 18):
+        # hedging off vs on over the same seeded slow-chip storm.
+        "gray_failure": gray,
         "replay_digest": summary["replay_digest"],
         "ok": summary["ok"],
     }))
@@ -965,6 +1119,9 @@ def main(argv=None):
         {k: v for k, v in summary.items() if k != "by_class"}))
     if not summary["ok"]:
         failed = [g for g, ok in summary["gates"].items() if not ok]
+        if gray is not None and not gray["ok"]:
+            failed += [f"gray_failure.{g}"
+                       for g, ok in gray["gates"].items() if not ok]
         print(f"VIOLATION: service_slo gates failed: {failed} "
               f"(replay with --seed {summary['seed']:#x})",
               file=sys.stderr)
