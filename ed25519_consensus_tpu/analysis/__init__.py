@@ -3,12 +3,15 @@
 The package's consensus-grade claims rest on invariants that used to
 live only in prose (docs/failure-model.md) and reviewers' heads:
 integer-only device math, injected clocks, centralized env knobs, no
-iteration-order-dependent verdict aggregation, secret hygiene.  This
-subpackage machine-checks them on every commit, in three layers:
+iteration-order-dependent verdict aggregation, secret hygiene, one
+owning lock per shared field.  This subpackage machine-checks them on
+every commit, in four layers:
 
 * **Layer 1 — AST linter** (`linter.py`): the numbered invariant
-  catalog CL001–CL006 over the package's syntax trees, with an
-  explicit, justified waiver file (`waivers.toml`).
+  catalog CL001–CL009 over the package's syntax trees, with an
+  explicit, justified waiver file (`waivers.toml`); the concurrency
+  pair CL008/CL009 (`guards.py`) checks the committed field→lock map
+  (`guards.toml`) and bans effects under held locks.
 * **Layer 2 — IR audit** (`ir_audit.py`): trace the jitted device MSM
   and every selectable Pallas kernel variant in interpret mode, walk
   the jaxprs, and hold them to a committed primitive manifest
@@ -18,6 +21,9 @@ subpackage machine-checks them on every commit, in three layers:
   instrumented `threading` layer that records the lock-acquisition
   graph across the threaded test suites and fails on cycles, turning
   the package's lock hierarchy into a checked partial order.
+* **Layer 4 — write-race sanitizer** (`race_audit.py`): an
+  Eraser-style lockset monitor over the same suites — every field
+  written by two or more threads must carry a common lock.
 
 The full catalog, the derived lock hierarchy, and the waiver policy are
 documented in docs/consensus-invariants.md.

@@ -45,6 +45,30 @@ Findings are `(rule, path, line, symbol, message)`; a committed waiver
 mandatory one-line justification.  Unused waivers are themselves
 errors — the waiver file can never silently outlive the code it
 excused.
+
+Round 19 adds the concurrency half of the catalog (analysis/guards.py):
+
+* **CL008 guarded-by discipline** — the committed `guards.toml` maps
+  every mutable field of the heavily threaded classes (VerifyService,
+  _DeviceLane, the health registries and LatencyLedger,
+  DeviceOperandCache, VerdictCache, VerdictJournal, ReplicaSet) to its
+  owning lock attribute; every read/write outside `with self.<lock>`
+  (or `__init__` / an allowlisted caller-holds-the-lock accessor / an
+  `.acquire()`-balanced method) is a finding, and a mapping entry that
+  drifted from the source (renamed class/field/lock) is an ERROR.
+* **CL009 locks-never-hold-effects** — inside any `with <repo-lock>`
+  block (DEVICE_CALL_LOCK excluded — holding it across dispatch is its
+  purpose), the effect verbs the failure model forbids under locks are
+  findings: residency/chip-drop listener notification, device dispatch
+  entry points, `time.sleep` / blocking `.wait()` on a DIFFERENT
+  object's condition, filesystem writes (the verdict journal's
+  own-lock/own-file append in persist.py is the one sanctioned shape),
+  and print/logging of secret-bearing state.
+
+The static rules' dynamic complement is `analysis/race_audit.py` (the
+Eraser-style write-race sanitizer driven over the threaded suites
+under ED25519_TPU_RACE_AUDIT=1) plus `analysis/lockorder.py` (the
+acquisition-order cycle audit) — see docs/consensus-invariants.md.
 """
 
 import ast
@@ -67,7 +91,7 @@ MANIFEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "jaxpr_manifest.json")
 
 RULE_IDS = ("CL001", "CL002", "CL003", "CL004", "CL005", "CL006",
-            "CL007")
+            "CL007", "CL008", "CL009")
 
 # CL001 scope inside batch.py: the symbols on the verdict path (staging,
 # exact verification, the union/bisection machinery).  The scheduler
@@ -618,6 +642,18 @@ def _check_cl007(mod: ParsedModule):
                 f"VerdictCache.lookup()")
 
 
+def _check_cl008(mod):
+    # Lazy import: guards.py imports Finding/_parse_toml from this
+    # module, so the rule body resolves at call time, not import time.
+    from . import guards
+    return guards.check_cl008(mod)
+
+
+def _check_cl009(mod):
+    from . import guards
+    return guards.check_cl009(mod)
+
+
 RULES = {
     "CL001": _check_cl001,
     "CL002": _check_cl002,
@@ -626,6 +662,8 @@ RULES = {
     "CL005": _check_cl005,
     "CL006": _check_cl006,
     "CL007": _check_cl007,
+    "CL008": _check_cl008,
+    "CL009": _check_cl009,
 }
 
 

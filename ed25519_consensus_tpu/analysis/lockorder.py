@@ -27,13 +27,17 @@ order:
   it; a cycle is a latent deadlock and fails the run with the cycle
   path and example edges.
 
-Driven by the threaded suites (test_service / test_scheduler /
-test_faults) under ``ED25519_TPU_LOCK_AUDIT=1`` — tests/conftest.py
-installs the instrumentation before the package is imported and
-asserts acyclicity at session end.  This module must stay importable
-STANDALONE (stdlib only, no package imports): conftest loads it by
-file path before ``ed25519_consensus_tpu`` itself so that the
-package's module-level locks are created instrumented.
+Driven by all eight concurrent suites (test_service / test_scheduler
+/ test_faults / test_federation / test_persist / test_verdictcache /
+test_straggler / test_tenancy) under ``ED25519_TPU_LOCK_AUDIT=1`` —
+tests/conftest.py installs the instrumentation before the package is
+imported and asserts acyclicity at session end.  The same per-thread
+held-lock stacks feed the dynamic write-race sanitizer
+(analysis/race_audit.py, ``ED25519_TPU_RACE_AUDIT=1``), which is why
+the race audit implies this instrumentation.  This module must stay
+importable STANDALONE (stdlib only, no package imports): conftest
+loads it by file path before ``ed25519_consensus_tpu`` itself so that
+the package's module-level locks are created instrumented.
 """
 
 import json

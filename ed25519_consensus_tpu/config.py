@@ -342,6 +342,21 @@ KNOBS: "dict[str, Knob]" = dict([
        "Default seed for tools/straggler_lab.py's gray-failure "
        "scenario: the workload, the slow-chip fault plan, and the "
        "gray-flap windows (the run is a pure function of it)."),
+    _k("ED25519_TPU_RACE_AUDIT", "opt-in", False,
+       "Test-harness knob (read by tests/conftest.py, not package "
+       "code): instrument the hot concurrent classes' fields and run "
+       "the Eraser-style write-race sanitizer "
+       "(analysis/race_audit.py) over the session — any field "
+       "mutated by two or more threads with no common held lock "
+       "fails the run.  Implies the lock instrumentation "
+       "ED25519_TPU_LOCK_AUDIT provides.  Race evidence gates CI, "
+       "never verdicts."),
+    _k("ED25519_TPU_RACE_AUDIT_OUT", "path", None,
+       "With RACE_AUDIT: also write the session's race-audit report "
+       "(tracked fields, per-field locksets, flagged races) as a "
+       "JSON artifact at this path — the CI upload surface.  Read "
+       "back by `consensuslint --stats` for the race_audit_fields "
+       "gauge."),
 ])
 
 

@@ -407,7 +407,8 @@ class ReplicaSet:
                         _metrics.record_fault("federation_dedup_fanout")
                         return fed0
         candidates, first = self._candidates(digest, tenant_name, cls)
-        self.totals["submitted"] += 1
+        with self._lock:
+            self.totals["submitted"] += 1
         last_exc = None
         for i, rid in enumerate(candidates):
             rep = self.replicas[rid]
@@ -445,19 +446,28 @@ class ReplicaSet:
                     _health.REPLICA_EJECTED, _health.REPLICA_PROBATION):
                 self._sweep_ejected(rep)
             if rid == first:
-                self.totals["affinity_hits"] += 1
+                with self._lock:
+                    self.totals["affinity_hits"] += 1
             else:
-                self.totals["spillovers"] += 1
-                if (first is not None
-                        and self.registry.accepting(first)
-                        and self._degraded(self.replicas[first])):
-                    # The first choice was alive but degraded: this is
-                    # the shed-load-not-users spill, distinct from a
-                    # failover spill off an ejected/draining replica.
-                    self.totals["degraded_spills"] += 1
+                # The degraded-spill distinction reads the registry and
+                # peer depth — resolved BEFORE taking the lock (CL009:
+                # no call-outs while holding it).
+                degraded_spill = (
+                    first is not None
+                    and self.registry.accepting(first)
+                    and self._degraded(self.replicas[first]))
+                with self._lock:
+                    self.totals["spillovers"] += 1
+                    if degraded_spill:
+                        # The first choice was alive but degraded: this
+                        # is the shed-load-not-users spill, distinct
+                        # from a failover spill off an ejected/draining
+                        # replica.
+                        self.totals["degraded_spills"] += 1
                 _metrics.record_fault("federation_spillover")
             return fed
-        self.totals["rejected_overloaded"] += 1
+        with self._lock:
+            self.totals["rejected_overloaded"] += 1
         _metrics.record_fault("federation_reject_overloaded")
         if last_exc is not None:
             raise last_exc
@@ -485,7 +495,8 @@ class ReplicaSet:
 
     def _on_replica_error(self, rep: Replica, exc: Exception) -> None:
         ev = _health.classify_device_error(exc)
-        self.error_classes[ev.cls] += 1
+        with self._lock:
+            self.error_classes[ev.cls] += 1
         state = self.registry.state_of(rep.rid)
         if state in (_health.REPLICA_EJECTED,
                      _health.REPLICA_PROBATION):
@@ -510,7 +521,8 @@ class ReplicaSet:
             rep.rid, weight, f"{ev.cls}: {ev.reason}")
         if state == _health.REPLICA_DRAINING \
                 and before != _health.REPLICA_DRAINING:
-            self.totals["drains_started"] += 1
+            with self._lock:
+                self.totals["drains_started"] += 1
             _metrics.record_fault("replica_drain_started")
 
     def _eject(self, rep: Replica, reason: str,
@@ -518,7 +530,8 @@ class ReplicaSet:
         """Rung 4: eject the replica, surrender + re-issue its queue,
         drop its residency namespace."""
         self.registry.mark_ejected(rep.rid, reason)
-        self.totals["ejections"] += 1
+        with self._lock:
+            self.totals["ejections"] += 1
         _metrics.record_fault("replica_ejected")
         rep.crashed = rep.crashed or crashed
         rep.cache.drop_all(f"replica-ejected: {reason}")
@@ -569,7 +582,8 @@ class ReplicaSet:
                     # unavailable candidate — the host floor below
                     # still owes the ticket its resolution
                     continue
-                self.totals["reissued"] += 1
+                with self._lock:
+                    self.totals["reissued"] += 1
                 _metrics.record_fault("federation_reissue")
                 fed._point_at(ticket, rid)
                 with self._lock:
@@ -581,7 +595,8 @@ class ReplicaSet:
         # front-door tracked — a direct replica submission the
         # federation cannot re-point) — decide HERE with the exact
         # host math and resolve the original ticket.  Zero lost.
-        self.totals["host_floor"] += 1
+        with self._lock:
+            self.totals["host_floor"] += 1
         _metrics.record_fault("federation_host_floor")
         try:
             # rng=None: blinders come from the default secrets-grade
@@ -706,11 +721,14 @@ class ReplicaSet:
                     _persist.reload(rep.vcache)
                 rep.crashed = False
                 rep.degraded_frac = None
-                self.totals["revivals"] += 1
+                with self._lock:
+                    self.totals["revivals"] += 1
                 _metrics.record_fault("replica_revived")
-            self._probe_ord += 1
-            self.totals["probes"] += 1
-            want, v = self._probe_batch(self._probe_ord)
+            with self._lock:
+                self._probe_ord += 1
+                self.totals["probes"] += 1
+                probe_ord = self._probe_ord
+            want, v = self._probe_batch(probe_ord)
 
             def _probe(rep=rep, v=v):
                 ticket = rep.service.submit(
@@ -721,11 +739,13 @@ class ReplicaSet:
             ok, got = self._supervised(rep, _probe)
             if ok and got == want:
                 if self.registry.record_probe_pass(rid):
-                    self.totals["rejoins"] += 1
+                    with self._lock:
+                        self.totals["rejoins"] += 1
                     _metrics.record_fault("replica_rejoined")
                     self._prewarm_from_peers(rep)
             else:
-                self.totals["probe_failures"] += 1
+                with self._lock:
+                    self.totals["probe_failures"] += 1
                 self.registry.record_probe_fail(
                     rid, reason="probe verdict mismatch"
                     if ok else "probe dispatch failed")
@@ -752,8 +772,9 @@ class ReplicaSet:
         if not hints:
             return
         accepted, refused = rep.cache.import_warm_hints(hints)
-        self.totals["prewarm_hits"] += accepted
-        self.totals["prewarm_refused"] += refused
+        with self._lock:
+            self.totals["prewarm_hits"] += accepted
+            self.totals["prewarm_refused"] += refused
         if accepted:
             _metrics.record_fault("replica_prewarm", accepted)
 
@@ -768,12 +789,22 @@ class ReplicaSet:
     # -- observability + lifecycle ----------------------------------------
 
     def affinity_hit_rate(self) -> "float | None":
-        s = self.totals["submitted"] - self.totals["rejected_overloaded"]
-        return self.totals["affinity_hits"] / s if s > 0 else None
+        with self._lock:
+            s = (self.totals["submitted"]
+                 - self.totals["rejected_overloaded"])
+            hits = self.totals["affinity_hits"]
+        return hits / s if s > 0 else None
 
     def stats(self) -> dict:
         """Fleet snapshot: per-replica state/capacity/queues, the
         ladder ledger, affinity accounting, and the lifetime totals."""
+        # One consistent tally snapshot up front: the per-replica loop
+        # below calls out into replica services (never under _lock —
+        # CL009), so the guarded dicts are read exactly once here.
+        with self._lock:
+            totals = dict(self.totals)
+            error_classes = dict(self.error_classes)
+            dedup_by_replica = dict(self._dedup_by_replica)
         per = {}
         for rid in sorted(self.replicas):
             rep = self.replicas[rid]
@@ -802,7 +833,7 @@ class ReplicaSet:
                 },
                 # Front-door dedup fanned out onto this replica's
                 # in-flight ticket (the fleet_slo surface).
-                "dedup_fanout": self._dedup_by_replica.get(rid, 0),
+                "dedup_fanout": dedup_by_replica.get(rid, 0),
                 "crashed": rep.crashed,
                 "pumps": rep.pumps,
                 # Round 18: the replica's OWN namespaced pump-latency
@@ -817,8 +848,8 @@ class ReplicaSet:
             "replicas": per,
             "replica_states": self.registry.replica_states(),
             "affinity_hit_rate": self.affinity_hit_rate(),
-            "error_classes": dict(self.error_classes),
-            **self.totals,
+            "error_classes": error_classes,
+            **totals,
         }
 
     def close(self) -> None:

@@ -282,7 +282,8 @@ class VerdictJournal:
     def attach_cache(self, cache) -> None:
         """Remember the cache whose live entries compaction re-exports
         (export_entries — the sanctioned snapshot surface)."""
-        self._cache = cache
+        with self._lock:
+            self._cache = cache
 
     # -- the write side ----------------------------------------------------
 
@@ -383,7 +384,8 @@ class VerdictJournal:
         or stale bytes are scrubbed off the disk, every surviving
         record re-pinned under the live epoch regime.  Returns the
         snapshot's record count (None without an attached cache)."""
-        cache = self._cache
+        with self._lock:
+            cache = self._cache
         if cache is None:
             return None
         entries = cache.export_entries()

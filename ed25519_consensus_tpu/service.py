@@ -736,29 +736,41 @@ class VerifyService:
         if not wave:
             return 0
         now = self.now()
-        live = []
+        live, shed = [], []
         for req in wave:
             if req.deadline is not None and now >= req.deadline:
                 # Shed BEFORE dispatch: expired requests must not spend
                 # device/host time, and must resolve explicitly.
-                self.totals["shed_deadline"] += 1
-                self.by_class[req.cls]["shed_deadline"] += 1
-                _metrics.record_fault("service_shed_deadline")
-                req.ticket._fail(DeadlineExceeded())
+                shed.append(req)
             else:
                 live.append(req)
-        resolved = len(wave) - len(live)
+        if shed:
+            # Tallies land under the lock — stats() publishes a
+            # snapshot under _cv, so dispatcher-thread increments
+            # racing it are torn reads (CL008).  Ticket resolution
+            # stays OUTSIDE the lock (CL009: no effects under locks).
+            with self._cv:
+                for req in shed:
+                    self.totals["shed_deadline"] += 1
+                    self.by_class[req.cls]["shed_deadline"] += 1
+            for req in shed:
+                _metrics.record_fault("service_shed_deadline")
+                req.ticket._fail(DeadlineExceeded())
+        resolved = len(shed)
         if not live:
-            self.totals["waves"] += 1
+            with self._cv:
+                self.totals["waves"] += 1
             return resolved
 
         # Route: requests whose remaining budget is below the device
         # wave estimate fall back host-side NOW (the in-flight rung of
         # the ladder); the rest go wherever the breaker allows.
         urgent, routable = [], []
+        with self._cv:
+            device_estimate = self._device_estimate
         for req in live:
             if (req.deadline is not None
-                    and req.deadline - now < self._device_estimate):
+                    and req.deadline - now < device_estimate):
                 urgent.append(req)
             else:
                 routable.append(req)
@@ -774,15 +786,18 @@ class VerifyService:
             allowed, probe = self.breaker.allow_device()
             if not allowed:
                 urgent, routable = urgent + routable, []
-        self.totals["waves"] += 1
+        with self._cv:
+            self.totals["waves"] += 1
+            if urgent:
+                self.totals["host_waves"] += 1
+            if routable:
+                self.totals["device_waves"] += 1
+                if probe:
+                    self.totals["probe_waves"] += 1
         if urgent:
-            self.totals["host_waves"] += 1
             _metrics.record_fault("service_host_routed_waves")
             self._execute(urgent, device=False, probe=False)
         if routable:
-            self.totals["device_waves"] += 1
-            if probe:
-                self.totals["probe_waves"] += 1
             self._execute(routable, device=True, probe=probe)
         # Verdict memoization, the WRITE path (round 12): runs AFTER
         # the wave's verdict aggregation returned and every ticket is
@@ -838,17 +853,21 @@ class VerifyService:
         digest (exposed coalescing map, out-of-band invalidation)
         never dedup — full verification is always the safe default."""
         reps, rep_of, seen = [], [], {}
+        dedup = 0
         for r in reqs:
             d = r.verifier.content_digest()
             if d is not None and d in seen:
                 rep_of.append(seen[d])
-                self.totals["dedup_fanout"] += 1
+                dedup += 1
                 _metrics.record_fault("service_dedup_fanout")
                 continue
             if d is not None:
                 seen[d] = len(reps)
             rep_of.append(len(reps))
             reps.append(r.verifier)
+        if dedup:
+            with self._cv:
+                self.totals["dedup_fanout"] += dedup
         vs = reps
         try:
             if device:
@@ -873,7 +892,8 @@ class VerifyService:
                         # counted only when the resolved shape actually
                         # changed — a dead chip OUTSIDE this rung is
                         # not a degraded dispatch
-                        self.totals["degraded_waves"] += 1
+                        with self._cv:
+                            self.totals["degraded_waves"] += 1
                 # Probe waves force device participation (hybrid=False):
                 # a half-open breaker needs evidence, and a host-raced
                 # probe that never measures the device would stay
@@ -903,7 +923,8 @@ class VerifyService:
             # (crashed runtime, injected chaos beyond the lane seams)
             # must neither lose requests nor poison the service.  The
             # breaker counts it; every batch is re-decided host-side.
-            self.totals["crash_fallbacks"] += 1
+            with self._cv:
+                self.totals["crash_fallbacks"] += 1
             _metrics.record_fault("service_crash_fallback")
             if device:
                 self.breaker.record_failure("crash")
@@ -919,32 +940,33 @@ class VerifyService:
                 req.ticket._fail(verdict)
             else:
                 req.ticket._resolve(verdict)
-            self.totals["resolved"] += 1
-            self.by_class[req.cls]["resolved"] += 1
+        with self._cv:
+            for req in reqs:
+                self.totals["resolved"] += 1
+                self.by_class[req.cls]["resolved"] += 1
 
     def _note_device_outcome(self, stats: dict, probe: bool) -> None:
         """Feed one device-routed wave's verify_many stats to the
         breaker and the wave-time estimate."""
         dc = stats.get("devcache") or {}
-        if dc.get("hit"):
-            self.totals["devcache_hot_waves"] += 1
-        self.totals["devcache_dispatch_hits"] += dc.get(
-            "dispatch_hits", 0)
-        # Gray-failure roll-up (round 18): hedge pair outcomes and
-        # straggler attributions per wave, plus the latency-ledger
-        # gauges operators chart next to the SLO percentiles.
-        for k in ("hedges_fired", "hedges_won", "hedges_lost",
-                  "straggler_suspicion_events"):
-            self.totals[k] += stats.get(k, 0)
+        hedge_keys = ("hedges_fired", "hedges_won", "hedges_lost",
+                      "straggler_suspicion_events")
+        with self._cv:
+            if dc.get("hit"):
+                self.totals["devcache_hot_waves"] += 1
+            self.totals["devcache_dispatch_hits"] += dc.get(
+                "dispatch_hits", 0)
+            # Gray-failure roll-up (round 18): hedge pair outcomes and
+            # straggler attributions per wave; snapshotted here so the
+            # gauge publish below runs outside the lock (CL009).
+            for k in hedge_keys:
+                self.totals[k] += stats.get(k, 0)
+            hedge_snap = {k: self.totals[k] for k in hedge_keys}
         led = _health.chip_registry().latency
         _metrics.set_gauges({
             "latency_mesh_median_us": led.mesh_median_us(),
             "latency_wave_p95_us": led.wave_quantile_us(950),
-            "hedges_fired": self.totals["hedges_fired"],
-            "hedges_won": self.totals["hedges_won"],
-            "hedges_lost": self.totals["hedges_lost"],
-            "straggler_suspicion_events":
-                self.totals["straggler_suspicion_events"],
+            **hedge_snap,
         })
         failed = bool(stats.get("device_sick")) \
             or stats.get("device_errors", 0) > 0
@@ -963,8 +985,9 @@ class VerifyService:
             # device risk taking".
             dt = float(stats.get("seconds", 0.0))
             if dt > 0:
-                self._device_estimate = (
-                    0.6 * self._device_estimate + 0.4 * dt)
+                with self._cv:
+                    self._device_estimate = (
+                        0.6 * self._device_estimate + 0.4 * dt)
         elif probe:
             # The forced-device probe never measured the device (e.g. a
             # cold-shape compile grace drained everything host-side):
@@ -1048,8 +1071,11 @@ class VerifyService:
             self._cv.notify_all()
         for req in pending:
             req.ticket._fail(ServiceClosed())
-            self.totals["resolved"] += 1
-            self.by_class[req.cls]["resolved"] += 1
+        if pending:
+            with self._cv:
+                for req in pending:
+                    self.totals["resolved"] += 1
+                    self.by_class[req.cls]["resolved"] += 1
         if self._thread is not None:
             self._thread.join(timeout=60.0)
             self._thread = None

@@ -23,7 +23,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # package's module-level locks are created at import time), and
 # importing it as a package submodule would import the package first.
 _LOCK_AUDIT = None
-if os.environ.get("ED25519_TPU_LOCK_AUDIT"):
+_RACE_AUDIT = None
+# ED25519_TPU_RACE_AUDIT=1 (the write-race sanitizer, analysis/
+# race_audit.py) implies the lock instrumentation: the lockset
+# algorithm consumes the per-thread held-lock stacks the lock-order
+# monitor maintains.
+if os.environ.get("ED25519_TPU_LOCK_AUDIT") \
+        or os.environ.get("ED25519_TPU_RACE_AUDIT"):
     import importlib.util as _ilu
 
     _spec = _ilu.spec_from_file_location(
@@ -33,6 +39,22 @@ if os.environ.get("ED25519_TPU_LOCK_AUDIT"):
     _LOCK_AUDIT = _ilu.module_from_spec(_spec)
     _spec.loader.exec_module(_LOCK_AUDIT)
     _LOCK_AUDIT.install()
+
+if os.environ.get("ED25519_TPU_RACE_AUDIT"):
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_ed25519_tpu_race_audit",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     "ed25519_consensus_tpu", "analysis",
+                     "race_audit.py"))
+    _RACE_AUDIT = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_RACE_AUDIT)
+    # Held-lock evidence: the lock-order monitor's per-thread stack of
+    # (obj_id, creation-site name) pairs, reshaped to (name, id).
+    _RACE_AUDIT.MONITOR.held_provider = (
+        lambda: [(name, oid)
+                 for oid, name in _LOCK_AUDIT.MONITOR._stack()])
 
 import jax  # noqa: E402
 
@@ -163,6 +185,64 @@ def _lock_order_audit_at_session_end():
     assert not report["cycles"], (
         "cyclic lock-acquisition order observed (latent deadlock): "
         + "; ".join(" -> ".join(c) for c in report["cycles"]))
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _race_audit_session():
+    """With ED25519_TPU_RACE_AUDIT=1: instrument the hot concurrent
+    classes' stats dicts, registry score maps, cache LRU state, and
+    hedge counters at session start; at session end, run the Eraser
+    lockset check (analysis/race_audit.py) and fail the run on any
+    field mutated by two or more threads with no lock in common.  Race
+    evidence gates CI, never verdicts: nothing in the package imports
+    the sanitizer."""
+    if _RACE_AUDIT is None:
+        yield
+        return
+    from ed25519_consensus_tpu import (batch, devcache, federation,
+                                       health, persist, service,
+                                       verdictcache)
+
+    ic = _RACE_AUDIT.instrument_class
+    ic(service.VerifyService, "service.VerifyService",
+       dict_fields=("totals", "by_class", "_shedding_cls"),
+       attr_fields=("_queue_sigs", "_device_estimate", "_closed"))
+    ic(service.CircuitBreaker, "service.CircuitBreaker",
+       attr_fields=("_state", "_consecutive_failures"))
+    ic(batch._DeviceLane, "batch._DeviceLane",
+       dict_fields=("_results", "_started"),
+       attr_fields=("_next_id",))
+    ic(health.LatencyLedger, "health.LatencyLedger",
+       dict_fields=("_samples", "_streak", "_events"))
+    ic(health.ChipRegistry, "health.ChipRegistry",
+       dict_fields=("_dead", "_suspicion", "_state",
+                    "_probation_passes"))
+    ic(health.ReplicaRegistry, "health.ReplicaRegistry",
+       dict_fields=("_suspicion", "_state", "_probe_passes"))
+    ic(devcache.DeviceOperandCache, "devcache.DeviceOperandCache",
+       dict_fields=("_entries", "counters", "_tenant_counters",
+                    "_tenant_of", "_tenant_epoch"),
+       attr_fields=("_epoch", "_lookup_seq"))
+    ic(verdictcache.VerdictCache, "verdictcache.VerdictCache",
+       dict_fields=("_entries", "counters", "_tenant_counters",
+                    "_tenant_bytes", "_tenant_epoch"),
+       attr_fields=("_resident_bytes", "_epoch"))
+    ic(persist.VerdictJournal, "persist.VerdictJournal",
+       dict_fields=("counters",))
+    ic(federation.ReplicaSet, "federation.ReplicaSet",
+       dict_fields=("totals", "error_classes", "_front_dedup",
+                    "_dedup_by_replica"),
+       attr_fields=("_probe_ord", "_closed"))
+    yield
+    import sys
+
+    _RACE_AUDIT.uninstrument_all()
+    report = _RACE_AUDIT.finish(
+        write_path=os.environ.get("ED25519_TPU_RACE_AUDIT_OUT"))
+    print("\n" + _RACE_AUDIT.render(report), file=sys.stderr)
+    assert not report["flagged"], (
+        "write race(s) observed (disjoint locksets): "
+        + ", ".join(report["flagged"]))
 
 
 @pytest.fixture(autouse=True, scope="session")

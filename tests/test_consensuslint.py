@@ -1,7 +1,9 @@
 """Self-tests for the consensuslint AST layer (analysis/linter.py).
 
 A fixture corpus with one minimal POSITIVE (clean) and NEGATIVE
-(violating) case per rule CL001-CL006 — the acceptance gate that
+(violating) case per rule CL001-CL007 — the acceptance gate that
+(the concurrency pair CL008/CL009 has its own corpus in
+tests/test_guards.py) —
 `tools/consensuslint.py` exits nonzero on each violation class —
 plus the waiver machinery's contracts (suppression, mandatory
 justification, stale-waiver failure) and the HEAD gate: the real
@@ -1038,14 +1040,13 @@ def test_config_validate_all_reports_every_malformed_knob(monkeypatch):
 
 def test_config_registry_covers_readme_table():
     """Every registered knob has a doc line (the README table renders
-    these rows) and the registry knows all 52 knobs (46 through the
-    durable-verdict-state round + the six gray-failure knobs: the
-    straggler ratio and sample floor, the hedge quantile, floor, and
-    budget, and the straggler-lab seed)."""
+    these rows) and the registry knows all 54 knobs (52 through the
+    gray-failure round + the two race-audit knobs: the sanitizer
+    switch and its JSON artifact path)."""
     from ed25519_consensus_tpu import config
 
     rows = config.knob_table()
-    assert len(rows) == len(config.KNOBS) == 52
+    assert len(rows) == len(config.KNOBS) == 54
     assert all(doc for (_, _, _, doc) in rows)
     for name in ("ED25519_TPU_DEVCACHE_TENANT_QUOTA",
                  "ED25519_TPU_CLASS_WATERMARK_MEMPOOL",
@@ -1082,7 +1083,9 @@ def test_config_registry_covers_readme_table():
                  "ED25519_TPU_HEDGE_QUANTILE",
                  "ED25519_TPU_HEDGE_MIN_MS",
                  "ED25519_TPU_HEDGE_BUDGET",
-                 "ED25519_TPU_STRAGGLER_LAB_SEED"):
+                 "ED25519_TPU_STRAGGLER_LAB_SEED",
+                 "ED25519_TPU_RACE_AUDIT",
+                 "ED25519_TPU_RACE_AUDIT_OUT"):
         assert name in config.KNOBS
 
 

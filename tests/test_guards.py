@@ -1,0 +1,600 @@
+"""Self-tests for the concurrency analysis layer (analysis/guards.py +
+analysis/race_audit.py).
+
+Mirrors test_consensuslint.py's structure for the two new rules: a
+minimal POSITIVE (clean) and NEGATIVE (violating) fixture per CL008 /
+CL009 shape, the guards.toml drift contract (a renamed class / field /
+lock / accessor is an ERROR, same policy as stale waivers), the waiver
+round-trip, and the HEAD gate — `verify_mapping()` passes and the real
+tree carries zero active CL008/CL009 findings, which is also the
+regression pin for the round-19 counter-race fixes (service /
+federation / persist stats dicts now mutate only under their owning
+lock).  The race_audit half drives the Eraser lockset state machine
+directly with crafted threads: disjoint locksets flag, a common lock
+stays clean, and single-thread / init-handoff writers never flag.
+"""
+
+import os
+import threading
+
+import pytest
+
+from ed25519_consensus_tpu.analysis import guards, linter, race_audit
+
+
+def parsed(relpath: str, source: str):
+    """One in-memory fixture as if it lived at `relpath` inside the
+    package (same helper shape as test_consensuslint.lint_fixture)."""
+    return linter.ParsedModule(
+        path=f"<fixture:{relpath}>", source=source,
+        relpath=f"ed25519_consensus_tpu/{relpath}")
+
+
+def cl008(relpath, source, guard_list):
+    return list(guards.check_cl008(parsed(relpath, source),
+                                   guards=guard_list))
+
+
+def cl009(relpath, source):
+    return list(guards.check_cl009(parsed(relpath, source)))
+
+
+BOX_GUARD = guards.ClassGuard("box.py", "Box", "_lock", ["_state"])
+
+
+# -- CL008: guarded-by discipline ------------------------------------------
+
+def test_cl008_negative_write_outside_lock():
+    src = ("class Box:\n"
+           "    def poke(self):\n"
+           "        self._state = 'open'\n")
+    findings = cl008("box.py", src, [BOX_GUARD])
+    assert [f.rule for f in findings] == ["CL008"]
+    assert "write" in findings[0].message
+    assert findings[0].symbol == "Box.poke"
+
+
+def test_cl008_negative_read_outside_lock():
+    src = ("class Box:\n"
+           "    def peek(self):\n"
+           "        return self._state\n")
+    findings = cl008("box.py", src, [BOX_GUARD])
+    assert [f.rule for f in findings] == ["CL008"]
+    assert "read" in findings[0].message
+
+
+def test_cl008_negative_accessor_bypass():
+    # A helper that writes the field without holding the lock is a
+    # finding UNLESS the entry's accessor allowlist names it.
+    src = ("class Box:\n"
+           "    def _set_locked(self, v):\n"
+           "        self._state = v\n")
+    assert len(cl008("box.py", src, [BOX_GUARD])) == 1
+    allow = guards.ClassGuard("box.py", "Box", "_lock", ["_state"],
+                              accessors=["_set_locked"])
+    assert cl008("box.py", src, [allow]) == []
+
+
+def test_cl008_positive_inside_with_lock():
+    src = ("class Box:\n"
+           "    def poke(self):\n"
+           "        with self._lock:\n"
+           "            self._state = 'open'\n"
+           "            return self._state\n")
+    assert cl008("box.py", src, [BOX_GUARD]) == []
+
+
+def test_cl008_positive_init_exempt():
+    # Construction needs no lock: the object is not shared yet.
+    src = ("class Box:\n"
+           "    def __init__(self):\n"
+           "        self._state = 'closed'\n")
+    assert cl008("box.py", src, [BOX_GUARD]) == []
+
+
+def test_cl008_positive_acquire_balanced_method():
+    src = ("class Box:\n"
+           "    def poke(self):\n"
+           "        self._lock.acquire()\n"
+           "        try:\n"
+           "            self._state = 'open'\n"
+           "        finally:\n"
+           "            self._lock.release()\n")
+    assert cl008("box.py", src, [BOX_GUARD]) == []
+
+
+def test_cl008_class_level_state():
+    # ClassName._field is guarded wherever it appears; `with
+    # ClassName.<lock>` (or cls.<lock>) is the holding shape.
+    g = guards.ClassGuard("box.py", "Box", "_instance_lock",
+                          ["_instances"])
+    bad = ("class Box:\n"
+           "    def add(self):\n"
+           "        Box._instances[id(self)] = self\n")
+    assert len(cl008("box.py", bad, [g])) == 1
+    good = ("class Box:\n"
+            "    def add(self):\n"
+            "        with Box._instance_lock:\n"
+            "            Box._instances[id(self)] = self\n")
+    assert cl008("box.py", good, [g]) == []
+
+
+def test_cl008_other_class_same_field_name_is_clean():
+    # self._state inside a DIFFERENT class is someone else's field.
+    src = ("class Other:\n"
+           "    def poke(self):\n"
+           "        self._state = 1\n")
+    assert cl008("box.py", src, [BOX_GUARD]) == []
+
+
+def test_cl008_other_module_is_out_of_scope():
+    src = ("class Box:\n"
+           "    def poke(self):\n"
+           "        self._state = 1\n")
+    assert cl008("crate.py", src, [BOX_GUARD]) == []
+
+
+# -- CL009: locks never hold effects ---------------------------------------
+
+def test_cl009_negative_listener_under_lock():
+    src = ("class S:\n"
+           "    def drop(self, chip):\n"
+           "        with self._lock:\n"
+           "            notify_chip_drop(self._listeners, chip)\n")
+    findings = cl009("service.py", src)
+    assert [f.rule for f in findings] == ["CL009"]
+    assert "listener" in findings[0].message.lower()
+
+
+def test_cl009_negative_sleep_under_lock():
+    src = ("import time\n"
+           "class S:\n"
+           "    def spin(self):\n"
+           "        with self._cv:\n"
+           "            time.sleep(0.1)\n")
+    findings = cl009("service.py", src)
+    assert [f.rule for f in findings] == ["CL009"]
+    assert "sleep" in findings[0].message
+
+
+def test_cl009_negative_fsync_under_lock():
+    src = ("import os\n"
+           "class S:\n"
+           "    def flush(self, fd):\n"
+           "        with self._lock:\n"
+           "            os.fsync(fd)\n")
+    findings = cl009("devcache.py", src)
+    assert [f.rule for f in findings] == ["CL009"]
+    assert "filesystem write" in findings[0].message
+
+
+def test_cl009_negative_write_mode_open_under_lock():
+    src = ("class S:\n"
+           "    def dump(self, p):\n"
+           "        with self._lock:\n"
+           "            open(p, 'w')\n")
+    assert len(cl009("devcache.py", src)) == 1
+
+
+def test_cl009_negative_foreign_wait_under_lock():
+    src = ("class S:\n"
+           "    def stall(self):\n"
+           "        with self._lock:\n"
+           "            self._done_event.wait()\n")
+    findings = cl009("service.py", src)
+    assert len(findings) == 1
+    assert "DIFFERENT object" in findings[0].message
+
+
+def test_cl009_positive_wait_on_held_condition():
+    # Waiting on the condition you hold IS the sanctioned shape.
+    src = ("class S:\n"
+           "    def park(self):\n"
+           "        with self._cv:\n"
+           "            self._cv.wait()\n")
+    assert cl009("service.py", src) == []
+
+
+def test_cl009_negative_dispatch_under_lock():
+    src = ("class S:\n"
+           "    def run(self, y):\n"
+           "        with self._lock:\n"
+           "            block_until_ready(y)\n")
+    findings = cl009("service.py", src)
+    assert len(findings) == 1
+    assert "device dispatch" in findings[0].message
+
+
+def test_cl009_positive_device_call_lock_excluded():
+    # Holding DEVICE_CALL_LOCK across dispatch is its entire purpose.
+    src = ("def run(y):\n"
+           "    with DEVICE_CALL_LOCK:\n"
+           "        return block_until_ready(y)\n")
+    assert cl009("batch.py", src) == []
+
+
+def test_cl009_negative_secret_logging_under_lock():
+    src = ("class S:\n"
+           "    def leak(self):\n"
+           "        with self._lock:\n"
+           "            print(self.signing_key)\n")
+    findings = cl009("signing_key.py", src)
+    assert len(findings) == 1
+    assert "secret" in findings[0].message
+
+
+def test_cl009_negative_journal_append_under_lock():
+    src = ("class C:\n"
+           "    def store(self, rec):\n"
+           "        with self._lock:\n"
+           "            self.journal.append(rec)\n")
+    findings = cl009("verdictcache.py", src)
+    assert len(findings) == 1
+    assert "journal append" in findings[0].message
+
+
+def test_cl009_positive_verdict_journal_sanctioned_in_persist():
+    # The journal serializing its OWN file under its OWN lock is the
+    # one sanctioned fs-write-under-lock site.
+    src = ("import os\n"
+           "class VerdictJournal:\n"
+           "    def _append_locked(self, rec, fd):\n"
+           "        with self._lock:\n"
+           "            os.fsync(fd)\n")
+    assert cl009("persist.py", src) == []
+    # ...but only in persist.py, and only VerdictJournal.
+    assert len(cl009("verdictcache.py", src)) == 1
+
+
+def test_cl009_positive_effects_outside_lock():
+    src = ("import time\n"
+           "class S:\n"
+           "    def drop(self, chip):\n"
+           "        with self._lock:\n"
+           "            snap = dict(self._state)\n"
+           "        notify_chip_drop(self._listeners, chip)\n"
+           "        time.sleep(0)\n"
+           "        return snap\n")
+    assert cl009("service.py", src) == []
+
+
+def test_cl009_positive_metrics_under_lock_sanctioned():
+    src = ("class S:\n"
+           "    def tally(self, m):\n"
+           "        with self._lock:\n"
+           "            m.record_fault('oom')\n"
+           "            m.set_gauges({'depth': 1})\n")
+    assert cl009("service.py", src) == []
+
+
+# -- waiver round-trip ------------------------------------------------------
+
+def test_guards_waiver_round_trip():
+    src = ("class Box:\n"
+           "    def poke(self):\n"
+           "        self._state = 'open'\n")
+    findings = cl008("box.py", src, [BOX_GUARD])
+    waivers = [{"rule": "CL008",
+                "path": "ed25519_consensus_tpu/box.py",
+                "symbol": "Box.poke",
+                "reason": "test"}]
+    active, waived = linter.apply_waivers(findings, waivers)
+    assert active == [] and len(waived) == 1
+
+
+def test_guards_stale_waiver_fails():
+    waivers = [{"rule": "CL009",
+                "path": "ed25519_consensus_tpu/service.py",
+                "symbol": "nope",
+                "reason": "stale"}]
+    with pytest.raises(linter.WaiverError, match="stale"):
+        linter.apply_waivers([], waivers)
+
+
+# -- guards.toml loading + drift detection ---------------------------------
+
+def test_load_guards_rejects_missing_keys(tmp_path):
+    p = tmp_path / "guards.toml"
+    p.write_text('[[guard]]\nmodule = "box.py"\nclass = "Box"\n'
+                 'fields = "_state"\n')  # no lock
+    with pytest.raises(guards.GuardsError, match="lock"):
+        guards.load_guards(str(p))
+
+
+def test_load_guards_rejects_empty_fields(tmp_path):
+    p = tmp_path / "guards.toml"
+    p.write_text('[[guard]]\nmodule = "box.py"\nclass = "Box"\n'
+                 'lock = "_lock"\nfields = " , "\n')
+    with pytest.raises(guards.GuardsError, match="no fields"):
+        guards.load_guards(str(p))
+
+
+_DRIFT_SRC = ("import threading\n"
+              "class Box:\n"
+              "    def __init__(self):\n"
+              "        self._lock = threading.Lock()\n"
+              "        self._state = 'closed'\n"
+              "    def _set_locked(self, v):\n"
+              "        self._state = v\n")
+
+
+def test_verify_mapping_passes_on_matching_source(tmp_path):
+    (tmp_path / "box.py").write_text(_DRIFT_SRC)
+    g = guards.ClassGuard("box.py", "Box", "_lock", ["_state"],
+                          accessors=["_set_locked"])
+    guards.verify_mapping(guards=[g], package_root=str(tmp_path))
+
+
+def test_verify_mapping_renamed_field_is_error(tmp_path):
+    (tmp_path / "box.py").write_text(_DRIFT_SRC)
+    g = guards.ClassGuard("box.py", "Box", "_lock", ["_old_state"])
+    with pytest.raises(guards.GuardsError, match="renamed field"):
+        guards.verify_mapping(guards=[g], package_root=str(tmp_path))
+
+
+def test_verify_mapping_renamed_lock_is_error(tmp_path):
+    (tmp_path / "box.py").write_text(_DRIFT_SRC)
+    g = guards.ClassGuard("box.py", "Box", "_mutex", ["_state"])
+    with pytest.raises(guards.GuardsError, match="renamed lock"):
+        guards.verify_mapping(guards=[g], package_root=str(tmp_path))
+
+
+def test_verify_mapping_renamed_accessor_is_error(tmp_path):
+    (tmp_path / "box.py").write_text(_DRIFT_SRC)
+    g = guards.ClassGuard("box.py", "Box", "_lock", ["_state"],
+                          accessors=["_set_held"])
+    with pytest.raises(guards.GuardsError, match="renamed accessor"):
+        guards.verify_mapping(guards=[g], package_root=str(tmp_path))
+
+
+def test_verify_mapping_missing_class_and_module(tmp_path):
+    (tmp_path / "box.py").write_text(_DRIFT_SRC)
+    with pytest.raises(guards.GuardsError, match="not found"):
+        guards.verify_mapping(
+            guards=[guards.ClassGuard("box.py", "Crate", "_lock",
+                                      ["_state"])],
+            package_root=str(tmp_path))
+    with pytest.raises(guards.GuardsError, match="does not exist"):
+        guards.verify_mapping(
+            guards=[guards.ClassGuard("gone.py", "Box", "_lock",
+                                      ["_state"])],
+            package_root=str(tmp_path))
+
+
+# -- the HEAD gate ----------------------------------------------------------
+
+def test_committed_mapping_loads_and_is_fresh():
+    """guards.toml parses, covers the concurrent surface, and every
+    entry still resolves against the real tree (the drift gate that
+    `tools/consensuslint.py --guards` runs in CI)."""
+    committed = guards.load_guards()
+    assert committed, "the committed guards.toml must load"
+    guards.verify_mapping(guards=committed)
+    st = guards.guard_stats(committed)
+    assert st["guarded_fields"] >= 40
+    assert st["guarded_classes"] >= 8
+
+
+def test_real_tree_clean_under_committed_waivers():
+    """The real package carries zero ACTIVE CL008/CL009 findings —
+    the regression pin for the round-19 fixes: service / federation /
+    persist stats+counter dicts now mutate only under their owning
+    lock, and no effect verb runs inside a `with <lock>` block."""
+    findings = [f for f in linter.lint_package()
+                if f.rule in ("CL008", "CL009")]
+    waivers = [w for w in linter.load_waivers()
+               if w["rule"] in ("CL008", "CL009")]
+    active, _ = linter.apply_waivers(findings, waivers)
+    assert active == [], "unwaived concurrency findings on HEAD:\n" + \
+        "\n".join(str(f) for f in active)
+
+
+def test_counter_discipline_pinned_per_module():
+    """Per-module pin of the satellite fix: the three modules whose
+    submit-path counters raced their stats/snapshot readers are
+    individually clean under the committed mapping."""
+    committed = guards.load_guards()
+    pkg = os.path.dirname(os.path.dirname(
+        os.path.abspath(linter.__file__)))
+    for name in ("service.py", "federation.py", "persist.py"):
+        path = os.path.join(pkg, name)
+        with open(path, encoding="utf-8") as f:
+            mod = linter.ParsedModule(path=path, source=f.read())
+        assert list(guards.check_cl008(mod, guards=committed)) == [], \
+            f"{name}: guarded-field access outside its lock"
+        assert list(guards.check_cl009(mod)) == [], \
+            f"{name}: effect under a held lock"
+
+
+# -- the dynamic half: race_audit's Eraser lockset -------------------------
+
+def _monitor_with_held_map():
+    m = race_audit.RaceMonitor()
+    held = {}
+    m.held_provider = lambda: held.get(threading.get_ident(), ())
+    return m, held
+
+
+def _run(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_race_disjoint_locksets_flagged():
+    # Two threads each mutate the field under a DIFFERENT lock: the
+    # candidate lockset intersects to empty -> flagged.
+    m, held = _monitor_with_held_map()
+    b_may_go = threading.Event()
+    a_may_finish = threading.Event()
+
+    def a():
+        held[threading.get_ident()] = (("lock_a", 1),)
+        m.note("Svc.totals", 7)
+        b_may_go.set()
+        a_may_finish.wait(10)
+        m.note("Svc.totals", 7)
+
+    def b():
+        b_may_go.wait(10)
+        held[threading.get_ident()] = (("lock_b", 2),)
+        m.note("Svc.totals", 7)
+        a_may_finish.set()
+
+    ta = threading.Thread(target=a, daemon=True)
+    tb = threading.Thread(target=b, daemon=True)
+    ta.start(); tb.start()
+    ta.join(10); tb.join(10)
+    assert m.flagged() == [("Svc.totals", 7)]
+    report = m.report()
+    assert report["flagged"] == ["Svc.totals#7"]
+    assert "RACE Svc.totals#7" in race_audit.render(report)
+
+
+def test_race_common_lock_is_clean():
+    # Same interleaving, but both threads also hold a COMMON lock:
+    # the intersection stays nonempty -> clean.
+    m, held = _monitor_with_held_map()
+    b_may_go = threading.Event()
+    a_may_finish = threading.Event()
+
+    def a():
+        held[threading.get_ident()] = (("lock_a", 1), ("the_cv", 9))
+        m.note("Svc.totals", 7)
+        b_may_go.set()
+        a_may_finish.wait(10)
+        m.note("Svc.totals", 7)
+
+    def b():
+        b_may_go.wait(10)
+        held[threading.get_ident()] = (("lock_b", 2), ("the_cv", 9))
+        m.note("Svc.totals", 7)
+        a_may_finish.set()
+
+    ta = threading.Thread(target=a, daemon=True)
+    tb = threading.Thread(target=b, daemon=True)
+    ta.start(); tb.start()
+    ta.join(10); tb.join(10)
+    assert m.flagged() == []
+    (entry,) = m.report()["fields"]["Svc.totals"]
+    assert entry["state"] == "shared"
+    assert entry["lockset"] == ["the_cv"]
+
+
+def test_race_single_thread_never_flagged():
+    # One thread, no locks at all, many writes: never a race.
+    m, _ = _monitor_with_held_map()
+    _run(lambda: [m.note("Lane._results", 3) for _ in range(100)])
+    assert m.flagged() == []
+    (entry,) = m.report()["fields"]["Lane._results"]
+    assert entry["state"] == "exclusive" and entry["writes"] == 100
+
+
+def test_race_init_handoff_never_flagged():
+    # The handoff pattern: construction on one thread, then a SINGLE
+    # worker owns the field.  Only one post-sharing writer -> clean,
+    # even with no locks anywhere.
+    m, _ = _monitor_with_held_map()
+    _run(lambda: m.note("Svc._queue_sigs", 5))          # init thread
+    _run(lambda: [m.note("Svc._queue_sigs", 5) for _ in range(50)])
+    assert m.flagged() == []
+    (entry,) = m.report()["fields"]["Svc._queue_sigs"]
+    assert entry["state"] == "shared" and entry["threads"] == 2
+
+
+def test_tracked_dict_reports_all_mutators():
+    m, _ = _monitor_with_held_map()
+    d = race_audit.TrackedDict(m, "C.counters", 11,
+                               {"hits": 0, "rows": {"a": 1}})
+    d["hits"] = 1
+    d.update(misses=2)
+    d.setdefault("evictions", 0)
+    d.setdefault("hits", 99)          # existing key: not a write
+    d.pop("misses")
+    del d["evictions"]
+    d.clear()
+    (entry,) = m.report()["fields"]["C.counters"]
+    assert entry["writes"] == 6       # 6 mutators (construction is
+    assert d == {}                    # not an event)
+
+
+def test_tracked_dict_preserves_stored_value_identity():
+    # The devcache row pattern: insert a dict, keep the original
+    # reference, mutate through it.  The sanitizer must not swap in a
+    # copy (that would silently change program semantics — the
+    # round-19 tenancy-counter incident).
+    m, _ = _monitor_with_held_map()
+    d = race_audit.TrackedDict(m, "C.rows", 11)
+    row = {"quota_rejected": 0}
+    d["Y"] = row
+    row["quota_rejected"] += 1
+    assert d["Y"] is row
+    assert d["Y"]["quota_rejected"] == 1
+    got = d.setdefault("Z", {"n": 0})
+    got["n"] += 1
+    assert d["Z"] is got and d["Z"]["n"] == 1
+
+
+def test_recycled_id_never_merges_histories():
+    # Instance keys are generation serials, not raw id(): a new object
+    # allocated at a dead object's address must start a FRESH history
+    # (a merged one makes construction writes look like unlocked
+    # post-sharing writes — a false race).
+    import weakref
+
+    m = race_audit.RaceMonitor()
+
+    class O:
+        pass
+
+    class Dead:
+        pass
+
+    live = O()
+    tmp = Dead()
+    wref = weakref.ref(tmp)
+    del tmp
+    assert wref() is None
+    m._serials[id(live)] = (wref, 41)   # simulate a recycled address
+    m._serial_count = 41
+    assert m._owner_key(live) == 42     # new generation, new serial
+    assert m._owner_key(live) == 42     # ...stable thereafter
+    assert m._owner_key(7) == 7         # int tokens stay opaque
+
+
+def test_instrument_class_tracks_and_uninstruments():
+    m, _ = _monitor_with_held_map()
+
+    class Crate:
+        def __init__(self):
+            self.totals = {"waves": 0}
+            self._epoch = 0
+
+    race_audit.instrument_class(Crate, "Crate",
+                                dict_fields=("totals",),
+                                attr_fields=("_epoch",), monitor=m)
+    try:
+        c = Crate()
+        assert isinstance(c.totals, race_audit.TrackedDict)
+        c.totals["waves"] += 1
+        c._epoch = 1
+        c._unrelated = "x"            # untracked attr: no event
+        report = m.report()
+        assert set(report["fields"]) == {"Crate.totals", "Crate._epoch"}
+        assert report["fields"]["Crate.totals"][0]["writes"] == 2
+    finally:
+        race_audit.uninstrument_all(m)
+    c2 = Crate()
+    assert type(c2.totals) is dict    # patch removed
+    assert m._instrumented == []
+
+
+def test_finish_writes_json_artifact(tmp_path):
+    m, _ = _monitor_with_held_map()
+    _run(lambda: m.note("X.f", 1))
+    out = tmp_path / "race-audit.json"
+    report = race_audit.finish(write_path=str(out), monitor=m)
+    assert report["fields_tracked"] == 1 and report["flagged"] == []
+    import json
+    assert json.loads(out.read_text()) == report

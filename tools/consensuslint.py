@@ -1,9 +1,17 @@
 """consensuslint CLI — the consensus-safety static analysis front door.
 
     python tools/consensuslint.py ed25519_consensus_tpu/
-        Layer 1: run the CL001-CL006 AST rule catalog over the package,
+        Layer 1: run the CL001-CL009 AST rule catalog over the package,
         apply analysis/waivers.toml, exit nonzero on any unwaived
         finding (or any stale waiver).
+
+    python tools/consensuslint.py --guards
+        The concurrency slice of layer 1: verify the committed
+        guarded-by mapping (analysis/guards.toml) still resolves
+        against the source — a renamed class/field/lock/accessor is an
+        ERROR — then run only CL008 (guarded-by discipline) and CL009
+        (locks-never-hold-effects) over the package and print the
+        guard-coverage stats.
 
     python tools/consensuslint.py --ir-audit
         Layer 2: trace the device MSM + every selectable Pallas kernel
@@ -17,11 +25,17 @@
         and publish them into utils.metrics gauges (the soak tooling
         asserts the waiver count never silently grows).
 
-Layer 3 (lock-order verification) runs inside pytest:
-    ED25519_TPU_LOCK_AUDIT=1 python -m pytest tests/test_service.py \
-        tests/test_scheduler.py tests/test_faults.py -q
+Layers 3 and 4 (lock-order + write-race verification) run inside
+pytest, driven over all eight concurrent suites:
+    ED25519_TPU_LOCK_AUDIT=1 ED25519_TPU_RACE_AUDIT=1 \
+    python -m pytest tests/test_service.py tests/test_scheduler.py \
+        tests/test_faults.py tests/test_federation.py \
+        tests/test_persist.py tests/test_verdictcache.py \
+        tests/test_straggler.py tests/test_tenancy.py -q
 (tests/conftest.py installs the instrumentation and fails the session
-on a cyclic lock-acquisition graph; see docs/consensus-invariants.md).
+on a cyclic lock-acquisition graph or on any field mutated by two or
+more threads with disjoint held-lock sets — the Eraser lockset check,
+analysis/race_audit.py; see docs/consensus-invariants.md).
 """
 
 import argparse
@@ -37,7 +51,7 @@ from ed25519_consensus_tpu.analysis import linter  # noqa: E402
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="consensuslint",
-        description="consensus-safety static analysis (CL001-CL006 + "
+        description="consensus-safety static analysis (CL001-CL009 + "
                     "jaxpr audit)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
@@ -47,6 +61,10 @@ def main(argv=None) -> int:
                     help="report every finding, waived or not")
     ap.add_argument("--stats", action="store_true",
                     help="print stats JSON and publish metrics gauges")
+    ap.add_argument("--guards", action="store_true",
+                    help="verify the guarded-by mapping against the "
+                         "source (drift = error) and run only the "
+                         "concurrency rules CL008/CL009")
     ap.add_argument("--ir-audit", action="store_true",
                     help="run the Layer-2 jaxpr audit against the "
                          "committed manifest")
@@ -60,19 +78,68 @@ def main(argv=None) -> int:
 
         return ir_audit.main(write=args.write_manifest)
 
+    if args.guards:
+        from ed25519_consensus_tpu.analysis import guards
+
+        try:
+            guards.verify_mapping()
+        except guards.GuardsError as e:
+            print(f"consensuslint: guards drift: {e}", file=sys.stderr)
+            return 2
+        gst = guards.guard_stats()
+        print("guards mapping ok: "
+              f"{gst['guarded_fields']} field(s) across "
+              f"{gst['guarded_classes']} class(es), "
+              f"{gst['guard_accessors']} accessor(s)")
+
     findings = (linter.lint_paths(args.paths) if args.paths
                 else linter.lint_package())
+    if args.guards:
+        findings = [f for f in findings
+                    if f.rule in ("CL008", "CL009")]
     try:
         waivers = [] if args.no_waivers else linter.load_waivers(
             args.waivers)
+        if args.guards:
+            # Only the concurrency rules are in scope: other rules'
+            # waivers are neither applied nor staleness-checked here
+            # (the full run does that).
+            waivers = [w for w in waivers
+                       if w["rule"] in ("CL008", "CL009")]
         active, waived = linter.apply_waivers(findings, waivers)
     except linter.WaiverError as e:
         print(f"consensuslint: waiver error: {e}", file=sys.stderr)
         return 2
 
     if args.stats:
+        from ed25519_consensus_tpu.analysis import guards
+        from ed25519_consensus_tpu.utils import metrics
+
         st = linter.publish_gauges(
             linter.stats(findings=findings, waivers=waivers))
+        # Concurrency-layer coverage gauges: the guard map's breadth
+        # (a shrinking map is as reviewable as a growing waiver list)
+        # and the latest race-audit artifact's tracked-field count
+        # (0 until a suite run under ED25519_TPU_RACE_AUDIT=1 wrote
+        # one to ED25519_TPU_RACE_AUDIT_OUT).
+        gst = guards.guard_stats()
+        st["cl008_guarded_fields"] = gst["guarded_fields"]
+        st["cl008_guard_accessors"] = gst["guard_accessors"]
+        st["race_audit_fields"] = 0
+        race_out = os.environ.get("ED25519_TPU_RACE_AUDIT_OUT")
+        if race_out and os.path.exists(race_out):
+            import json
+
+            with open(race_out, encoding="utf-8") as f:
+                st["race_audit_fields"] = json.load(f).get(
+                    "fields_tracked", 0)
+        metrics.set_gauges({
+            "consensuslint_cl008_guarded_fields":
+                st["cl008_guarded_fields"],
+            "consensuslint_cl008_guard_accessors":
+                st["cl008_guard_accessors"],
+            "race_audit_fields": st["race_audit_fields"],
+        })
         print(linter.render_stats(st))
         return 0 if not st["findings_active"] else 1
 
